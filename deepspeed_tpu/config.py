@@ -1,0 +1,295 @@
+"""JSON config system with batch-size inference.
+
+TPU-native analog of the reference's ``deepspeed/pt/deepspeed_config.py``
+(/root/reference/deepspeed/pt/deepspeed_config.py:234-421).  Same JSON schema,
+same batch "triangle" solver over {train_batch_size,
+train_micro_batch_size_per_gpu, gradient_accumulation_steps}, same error
+checks.  The one structural difference: world size comes from the device mesh
+(data-parallel axis size) instead of ``torch.distributed``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Mapping, Optional
+
+from deepspeed_tpu import constants as C
+
+logger = logging.getLogger(__name__)
+
+
+def get_scalar_param(d: Mapping[str, Any], name: str, default):
+    """Fetch ``name`` from dict with default (reference deepspeed_config.py:18-25)."""
+    if d is None:
+        return default
+    return d.get(name, default)
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class FP16Params:
+    """fp16 section (reference deepspeed_constants.py:84-118)."""
+
+    def __init__(self, param_dict: Mapping[str, Any]):
+        sub = param_dict.get(C.FP16, None)
+        self.enabled = get_scalar_param(sub, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)
+        self.loss_scale = get_scalar_param(sub, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = get_scalar_param(
+            sub, C.FP16_INITIAL_SCALE_POWER, C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.loss_scale_window = get_scalar_param(
+            sub, C.FP16_LOSS_SCALE_WINDOW, C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.hysteresis = get_scalar_param(sub, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = get_scalar_param(
+            sub, C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT)
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+
+class TensorboardParams:
+    def __init__(self, param_dict: Mapping[str, Any]):
+        sub = param_dict.get(C.TENSORBOARD, None)
+        self.enabled = get_scalar_param(sub, C.TENSORBOARD_ENABLED, C.TENSORBOARD_ENABLED_DEFAULT)
+        self.output_path = get_scalar_param(
+            sub, C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.job_name = get_scalar_param(
+            sub, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+
+class DeepSpeedConfig:
+    """Flat-attribute config object (reference deepspeed_config.py:234-330).
+
+    Args:
+      config: path to a JSON file or an already-parsed dict.
+      dp_world_size: size of the data-parallel mesh axis.  The reference derives
+        this from torch.distributed / the mpu (deepspeed_config.py:236-250);
+        here the engine passes it from the mesh.
+    """
+
+    def __init__(self, config, dp_world_size: Optional[int] = None):
+        if isinstance(config, str):
+            try:
+                with open(config, "r") as f:
+                    self._param_dict = json.load(f)
+            except Exception as e:
+                raise DeepSpeedConfigError(
+                    f"Could not read DeepSpeed config file {config!r}: {e}")
+        elif isinstance(config, Mapping):
+            self._param_dict = dict(config)
+        else:
+            raise DeepSpeedConfigError(
+                f"config must be a JSON path or dict, got {type(config)}")
+
+        self.world_size = dp_world_size if dp_world_size is not None else 1
+        self._initialize_params(self._param_dict)
+        self._set_batch_related_parameters()
+        self._do_error_check()
+        self._do_warning_check()
+
+    # ------------------------------------------------------------------ params
+
+    def _initialize_params(self, pd: Mapping[str, Any]):
+        self.train_batch_size = get_scalar_param(
+            pd, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get_scalar_param(
+            pd, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+
+        self.disable_allgather = get_scalar_param(
+            pd, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.allgather_size = get_scalar_param(pd, C.ALLGATHER_SIZE, C.ALLGATHER_SIZE_DEFAULT)
+        self.fp32_allreduce = get_scalar_param(pd, C.FP32_ALLREDUCE, C.FP32_ALLREDUCE_DEFAULT)
+        self.prescale_gradients = get_scalar_param(
+            pd, C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(
+            pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+
+        # zero_optimization is a plain boolean in the reference (v0.1.0,
+        # deepspeed_constants.py:137-146); also accept {"stage": N} spelling.
+        zero = get_scalar_param(pd, C.ZERO_OPTIMIZATION, C.ZERO_OPTIMIZATION_DEFAULT)
+        if isinstance(zero, Mapping):
+            self.zero_stage = int(zero.get("stage", 0))
+            self.zero_enabled = self.zero_stage > 0
+            self.zero_parameter_parallel_size = zero.get(
+                C.ZERO_PARAMETER_PARALLEL_SIZE, C.ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT)
+        else:
+            self.zero_enabled = bool(zero)
+            self.zero_stage = 1 if self.zero_enabled else 0
+            self.zero_parameter_parallel_size = C.ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT
+
+        self.gradient_clipping = get_scalar_param(
+            pd, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+
+        self.fp16 = FP16Params(pd)
+        self.fp16_enabled = self.fp16.enabled
+        bf16_sub = pd.get(C.BF16, None)
+        self.bf16_enabled = get_scalar_param(bf16_sub, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)
+
+        # loss-scale convenience attributes matching the reference getter facade
+        # (deepspeed_light.py:252-276)
+        self.loss_scale = self.fp16.loss_scale
+        self.dynamic_loss_scale = self.fp16.dynamic_loss_scale
+        self.dynamic_loss_scale_args = {
+            "init_scale": 2 ** self.fp16.initial_scale_power,
+            "scale_window": self.fp16.loss_scale_window,
+            "delayed_shift": self.fp16.hysteresis,
+            "min_scale": self.fp16.min_loss_scale,
+        } if self.fp16.dynamic_loss_scale else None
+
+        opt = pd.get(C.OPTIMIZER, None)
+        self.optimizer_name = None
+        self.optimizer_params = None
+        self.optimizer_legacy_fusion = False
+        if opt is not None:
+            name = opt.get(C.OPTIMIZER_TYPE, None)
+            self.optimizer_name = name.lower() if isinstance(name, str) else name
+            self.optimizer_params = dict(opt.get(C.OPTIMIZER_PARAMS, {}))
+            self.optimizer_legacy_fusion = bool(opt.get("legacy_fusion", False))
+
+        sched = pd.get(C.SCHEDULER, None)
+        self.scheduler_name = None
+        self.scheduler_params = None
+        if sched is not None:
+            self.scheduler_name = sched.get(C.SCHEDULER_TYPE, None)
+            self.scheduler_params = dict(sched.get(C.SCHEDULER_PARAMS, {}))
+
+        self.wall_clock_breakdown = get_scalar_param(
+            pd, C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(
+            pd, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.tensorboard = TensorboardParams(pd)
+        self.tensorboard_enabled = self.tensorboard.enabled
+        self.tensorboard_output_path = self.tensorboard.output_path
+        self.tensorboard_job_name = self.tensorboard.job_name
+
+        self.model_parallel_size = get_scalar_param(
+            pd, C.MODEL_PARALLEL_SIZE, C.MODEL_PARALLEL_SIZE_DEFAULT)
+
+    # ----------------------------------------------------------- batch triangle
+
+    def _batch_assertion(self):
+        """All three set: assert positivity + the product identity
+        (reference deepspeed_config.py:292-310)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        if not train_batch > 0:
+            raise DeepSpeedConfigError(
+                f"Train batch size: {train_batch} has to be greater than 0")
+        if not micro_batch > 0:
+            raise DeepSpeedConfigError(
+                f"Micro batch size per gpu: {micro_batch} has to be greater than 0")
+        if not grad_acc > 0:
+            raise DeepSpeedConfigError(
+                f"Gradient accumulation steps: {grad_acc} has to be greater than 0")
+        if train_batch != micro_batch * grad_acc * self.world_size:
+            raise DeepSpeedConfigError(
+                f"Check batch related parameters. train_batch_size is not equal"
+                f" to micro_batch_per_gpu * gradient_acc_step * world_size"
+                f" {train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        """Infer whichever of the batch triple is missing
+        (reference deepspeed_config.py:312-366)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all provided or none
+        if all(x is not None for x in (train_batch, micro_batch, grad_acc)):
+            self._batch_assertion()
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+            self._batch_assertion()
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+            self._batch_assertion()
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+            self._batch_assertion()
+        elif micro_batch is not None:
+            if grad_acc is None:
+                self.gradient_accumulation_steps = 1
+            self.train_batch_size = (self.train_micro_batch_size_per_gpu
+                                     * self.gradient_accumulation_steps
+                                     * self.world_size)
+            self._batch_assertion()
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu"
+                " needs to be provided")
+
+    # ---------------------------------------------------------------- checking
+
+    def _do_error_check(self):
+        if self.zero_enabled:
+            # Reference requires fp16 for ZeRO (deepspeed_config.py:388-389);
+            # on TPU bf16 satisfies the same "low-precision model weights +
+            # fp32 sharded masters" contract.
+            if not (self.fp16_enabled or self.bf16_enabled):
+                raise DeepSpeedConfigError(
+                    "DeepSpeedConfig: ZeRO is only supported if fp16 or bf16 is enabled")
+        if self.fp16_enabled and self.bf16_enabled:
+            raise DeepSpeedConfigError(
+                "DeepSpeedConfig: fp16 and bf16 cannot both be enabled")
+        if not self.gradient_accumulation_steps:
+            raise DeepSpeedConfigError(
+                "DeepSpeedConfig: gradient_accumulation_steps is not defined")
+
+    def _do_warning_check(self):
+        """Reference deepspeed_config.py:395-421."""
+        fp16_enabled = self.fp16_enabled or self.zero_enabled
+        if self.gradient_clipping > 0.0 and not fp16_enabled:
+            logger.warning(
+                "DeepSpeedConfig: gradient clipping enabled without FP16 enabled.")
+        vocabulary_size = self._param_dict.get("vocabulary_size", None)
+        if vocabulary_size and vocabulary_size % C.MXU_ALIGN_SIZE != 0:
+            # Reference warns at align 8 for tensor cores
+            # (deepspeed_config.py:402-407); the MXU wants multiples of 128.
+            logger.warning(
+                "DeepSpeedConfig: vocabulary size %d is not aligned to %d, "
+                "may import MXU padding overhead", vocabulary_size, C.MXU_ALIGN_SIZE)
+        if (self.optimizer_params is not None
+                and C.MAX_GRAD_NORM in self.optimizer_params
+                and self.optimizer_params[C.MAX_GRAD_NORM] > 0):
+            if fp16_enabled:
+                # fp16 mode: pass max_grad_norm through to the fp16 wrapper as
+                # the clipping threshold (reference deepspeed_config.py:411-415)
+                logger.warning(
+                    "DeepSpeedConfig: In FP16 mode, DeepSpeed will pass %s:%s "
+                    "to FP16 wrapper", C.MAX_GRAD_NORM,
+                    self.optimizer_params[C.MAX_GRAD_NORM])
+            else:
+                # fp32 mode: not permitted, zero it out
+                # (reference deepspeed_config.py:416-421)
+                logger.warning(
+                    "DeepSpeedConfig: In FP32 mode, DeepSpeed does not permit "
+                    "MAX_GRAD_NORM (%s) > 0, setting to zero",
+                    self.optimizer_params[C.MAX_GRAD_NORM])
+                self.optimizer_params[C.MAX_GRAD_NORM] = 0.0
+
+    # ----------------------------------------------------------------- display
+
+    def print(self, name: str = "DeepSpeedConfig"):
+        """Pretty dump (reference deepspeed_config.py:368-385)."""
+        logger.info("%s is:", name)
+        for key in sorted(vars(self)):
+            if key.startswith("_"):
+                continue
+            logger.info("  %s %s", (key + " " * 30)[:30], getattr(self, key))
+        logger.info("  json = %s", json.dumps(self._param_dict, sort_keys=True, indent=2))

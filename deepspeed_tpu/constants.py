@@ -1,0 +1,161 @@
+"""Config keys and defaults.
+
+TPU-native analog of the reference's ``deepspeed/pt/deepspeed_constants.py``
+(see /root/reference/deepspeed/pt/deepspeed_constants.py:17-245).  Keys keep the
+reference's JSON spelling so existing DeepSpeed config files parse unchanged;
+TPU-only additions (``bf16``, mesh shape) are new keys that default off/auto.
+"""
+
+#############################################
+# Routes (reference deepspeed_constants.py:1-15)
+#############################################
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"
+ROUTE_PREDICT = "predict"
+ROUTE_ENCODE = "encode"
+
+#############################################
+# Batch size (reference deepspeed_constants.py:17-73)
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_BATCH_SIZE_DEFAULT = None
+
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
+
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
+
+#############################################
+# Optimizer / scheduler sections
+#############################################
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE = "type"
+OPTIMIZER_PARAMS = "params"
+MAX_GRAD_NORM = "max_grad_norm"
+
+SCHEDULER = "scheduler"
+SCHEDULER_TYPE = "type"
+SCHEDULER_PARAMS = "params"
+
+# Optimizer names understood by the engine (reference deepspeed_config.py:12-15).
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+LAMB_OPTIMIZER = "lamb"
+SGD_OPTIMIZER = "sgd"
+DEEPSPEED_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, SGD_OPTIMIZER]
+# Optimizers whose ZeRO interaction has been validated (reference
+# deepspeed_light.py:450-457 restricts ZeRO to Adam).
+ZERO_SUPPORTED_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER]
+
+#############################################
+# Steps
+#############################################
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+
+#############################################
+# Training options
+#############################################
+DISABLE_ALLGATHER = "disable_allgather"
+DISABLE_ALLGATHER_DEFAULT = False
+
+SPARSE_GRADIENTS = "sparse_gradients"
+SPARSE_GRADIENTS_DEFAULT = False
+
+#############################################
+# FP16 support (reference deepspeed_constants.py:84-118)
+#############################################
+FP16 = "fp16"
+FP16_ENABLED = "enabled"
+FP16_ENABLED_DEFAULT = False
+
+FP16_LOSS_SCALE = "loss_scale"
+FP16_LOSS_SCALE_DEFAULT = 0  # 0 => dynamic
+
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_INITIAL_SCALE_POWER_DEFAULT = 32
+
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_LOSS_SCALE_WINDOW_DEFAULT = 1000
+
+FP16_HYSTERESIS = "hysteresis"
+FP16_HYSTERESIS_DEFAULT = 2
+
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+FP16_MIN_LOSS_SCALE_DEFAULT = 1
+
+#############################################
+# BF16 (TPU-native addition; no reference analog — bf16 needs no loss scaling)
+#############################################
+BF16 = "bf16"
+BF16_ENABLED = "enabled"
+BF16_ENABLED_DEFAULT = False
+
+#############################################
+# Gradient clipping (reference deepspeed_constants.py:120-128)
+#############################################
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+
+#############################################
+# ZeRO optimization (reference deepspeed_constants.py:137-146; boolean in v0.1.0)
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+ZERO_OPTIMIZATION_DEFAULT = False
+
+#############################################
+# Communication options (reference deepspeed_constants.py:148-182)
+#############################################
+ALLGATHER_SIZE = "allgather_size"
+ALLGATHER_SIZE_DEFAULT = 500000000
+
+FP32_ALLREDUCE = "fp32_allreduce"
+FP32_ALLREDUCE_DEFAULT = False
+
+PRESCALE_GRADIENTS = "prescale_gradients"
+PRESCALE_GRADIENTS_DEFAULT = False
+
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
+
+#############################################
+# Logging / dumps (reference deepspeed_constants.py:184-223)
+#############################################
+DUMP_STATE = "dump_state"
+DUMP_STATE_DEFAULT = False
+
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+WALL_CLOCK_BREAKDOWN_DEFAULT = False
+
+MEMORY_BREAKDOWN = "memory_breakdown"
+MEMORY_BREAKDOWN_DEFAULT = False
+
+#############################################
+# TensorBoard (reference deepspeed_constants.py:225-245)
+#############################################
+TENSORBOARD = "tensorboard"
+TENSORBOARD_ENABLED = "enabled"
+TENSORBOARD_ENABLED_DEFAULT = False
+TENSORBOARD_OUTPUT_PATH = "output_path"
+TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
+TENSORBOARD_JOB_NAME = "job_name"
+TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
+
+#############################################
+# MXU alignment: the reference warns when vocab size isn't a multiple of 8 for
+# tensor cores (deepspeed_config.py:402-407).  TPU MXU tiles are 128-wide.
+#############################################
+MXU_ALIGN_SIZE = 128
+
+#############################################
+# Mesh / parallelism (TPU-native additions)
+#############################################
+MESH = "mesh"
+MESH_DATA_AXIS = "data"
+MESH_MODEL_AXIS = "model"
+MODEL_PARALLEL_SIZE = "model_parallel_size"
+MODEL_PARALLEL_SIZE_DEFAULT = 1
+
+ZERO_PARAMETER_PARALLEL_SIZE = "parameter_parallel_size"
+ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT = None

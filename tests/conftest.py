@@ -1,0 +1,16 @@
+"""Test rig: run everything on a virtual 8-device CPU mesh.
+
+The reference tests "multi-node" semantics by forking N local processes
+(/root/reference/tests/unit/common.py:14-100).  On TPU/XLA we get the same
+coverage cheaper: ``--xla_force_host_platform_device_count=8`` gives 8 fake
+devices in one process, so sharding, ZeRO partition math and collectives all
+execute for real.  Must be set before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

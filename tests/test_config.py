@@ -1,0 +1,179 @@
+"""Batch-triangle solver + config error checks.
+
+Behavioral equivalent of /root/reference/tests/unit/test_config.py:54-140.
+"""
+
+import json
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+WORLD = 2
+BASE = {"train_batch_size": 32, "fp16": {"enabled": True}}
+
+
+def test_check_version():
+    assert hasattr(deepspeed_tpu, "__git_hash__")
+    assert hasattr(deepspeed_tpu, "__git_branch__")
+    assert hasattr(deepspeed_tpu, "__version__")
+    assert hasattr(deepspeed_tpu, "__version_major__")
+    assert hasattr(deepspeed_tpu, "__version_minor__")
+    assert hasattr(deepspeed_tpu, "__version_patch__")
+
+
+def _solve(train_batch=None, micro_batch=None, gas=None, world=WORLD):
+    cfg = DeepSpeedConfig(dict(BASE), dp_world_size=world)
+    cfg.train_batch_size = train_batch
+    cfg.train_micro_batch_size_per_gpu = micro_batch
+    cfg.gradient_accumulation_steps = gas
+    try:
+        cfg._set_batch_related_parameters()
+        return cfg, True
+    except DeepSpeedConfigError:
+        return cfg, False
+
+
+def _assert_triple(cfg, ok, batch, micro_batch, gas, success):
+    if not success:
+        assert not ok
+        return
+    assert ok
+    assert cfg.train_batch_size == batch
+    assert cfg.train_micro_batch_size_per_gpu == micro_batch
+    assert cfg.gradient_accumulation_steps == gas
+
+
+@pytest.mark.parametrize('batch,micro_batch,gas,success',
+                         [(32, 16, 1, True),
+                          (32, 8, 2, True),
+                          (33, 17, 2, False),
+                          (32, 18, 1, False)])
+def test_batch_config(batch, micro_batch, gas, success):
+    # all three provided
+    cfg, ok = _solve(batch, micro_batch, gas)
+    _assert_triple(cfg, ok, batch, micro_batch, gas, success)
+
+    # train + micro
+    cfg, ok = _solve(train_batch=batch, micro_batch=micro_batch)
+    _assert_triple(cfg, ok, batch, micro_batch, gas, success)
+
+    if success:
+        cfg, ok = _solve(train_batch=batch, gas=gas)
+        _assert_triple(cfg, ok, batch, micro_batch, gas, success)
+
+        cfg, ok = _solve(micro_batch=micro_batch, gas=gas)
+        _assert_triple(cfg, ok, batch, micro_batch, gas, success)
+
+        if gas == 1:
+            cfg, ok = _solve(micro_batch=micro_batch)
+            _assert_triple(cfg, ok, batch, micro_batch, gas, success)
+
+            cfg, ok = _solve(train_batch=batch)
+            _assert_triple(cfg, ok, batch, micro_batch, gas, success)
+    else:
+        # only gas provided -> no batch size at all
+        cfg, ok = _solve(gas=gas)
+        assert not ok
+
+
+def test_none_at_all_fails():
+    _, ok = _solve()
+    assert not ok
+
+
+def test_temp_config_json(tmpdir):
+    config_dict = {"train_batch_size": 1}
+    path = tmpdir.join("temp_config.json")
+    with open(path, "w") as f:
+        json.dump(config_dict, f)
+    cfg = DeepSpeedConfig(str(path), dp_world_size=1)
+    assert cfg.train_batch_size == 1
+    assert cfg.train_micro_batch_size_per_gpu == 1
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_zero_requires_low_precision():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 4, "zero_optimization": True},
+                        dp_world_size=1)
+    # fp16 or bf16 satisfies it
+    cfg = DeepSpeedConfig({"train_batch_size": 4, "zero_optimization": True,
+                           "fp16": {"enabled": True}}, dp_world_size=1)
+    assert cfg.zero_enabled and cfg.zero_stage == 1
+    cfg = DeepSpeedConfig({"train_batch_size": 4, "zero_optimization": {"stage": 1},
+                           "bf16": {"enabled": True}}, dp_world_size=1)
+    assert cfg.zero_enabled
+
+
+def test_fp16_and_bf16_mutually_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 4,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, dp_world_size=1)
+
+
+def test_max_grad_norm_handling():
+    # fp16: passed through to the fp16 wrapper (reference deepspeed_config.py:411-415)
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "fp16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "max_grad_norm": 1.0}},
+    }, dp_world_size=1)
+    assert cfg.optimizer_params["max_grad_norm"] == 1.0
+    # fp32: zeroed out (reference deepspeed_config.py:416-421)
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "max_grad_norm": 1.0}},
+    }, dp_world_size=1)
+    assert cfg.optimizer_params["max_grad_norm"] == 0.0
+
+
+def test_zero_dict_without_stage_is_disabled():
+    cfg = DeepSpeedConfig({"train_batch_size": 4, "zero_optimization": {}},
+                          dp_world_size=1)
+    assert not cfg.zero_enabled
+    assert cfg.zero_stage == 0
+
+
+def test_loss_scale_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 4, "fp16": {"enabled": True}},
+                          dp_world_size=1)
+    assert cfg.dynamic_loss_scale
+    assert cfg.dynamic_loss_scale_args["init_scale"] == 2 ** 32
+    assert cfg.dynamic_loss_scale_args["scale_window"] == 1000
+    assert cfg.dynamic_loss_scale_args["delayed_shift"] == 2
+    assert cfg.dynamic_loss_scale_args["min_scale"] == 1
+
+    cfg = DeepSpeedConfig({"train_batch_size": 4,
+                           "fp16": {"enabled": True, "loss_scale": 128}},
+                          dp_world_size=1)
+    assert not cfg.dynamic_loss_scale
+    assert cfg.loss_scale == 128
+
+
+def test_comm_knobs_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 4}, dp_world_size=1)
+    assert cfg.allgather_size == 500000000
+    assert cfg.disable_allgather is False
+    assert cfg.fp32_allreduce is False
+    assert cfg.prescale_gradients is False
+    assert cfg.gradient_predivide_factor == 1.0
+    assert cfg.sparse_gradients_enabled is False
+    assert cfg.gradient_clipping == 0.0
+    assert cfg.steps_per_print == 10
+    assert cfg.wall_clock_breakdown is False
+
+
+def test_optimizer_scheduler_sections():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.00015}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.00015}},
+    }, dp_world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 0.00015
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_max_lr"] == 0.00015

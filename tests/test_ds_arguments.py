@@ -1,0 +1,66 @@
+"""Argparse surface (behavioral equivalent of
+/root/reference/tests/unit/test_ds_arguments.py:12-100)."""
+
+import argparse
+
+import pytest
+
+import deepspeed_tpu
+
+
+def basic_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int)
+    return parser
+
+
+def test_no_ds_arguments_no_ds_parser():
+    args = basic_parser().parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert not hasattr(args, "deepspeed")
+    assert not hasattr(args, "deepspeed_config")
+
+
+def test_no_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+    assert args.deepspeed_mpi is False
+
+
+def test_config_argument_only():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2", "--deepspeed_config", "foo.json"])
+    assert args.deepspeed is False
+    assert isinstance(args.deepspeed_config, str)
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_enable_argument_only():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2", "--deepspeed"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config is None
+
+
+def test_no_ds_parser_rejects_flags():
+    with pytest.raises(SystemExit):
+        basic_parser().parse_args(["--num_epochs", "2", "--deepspeed"])
+
+
+def test_core_arguments_together():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(
+        ["--num_epochs", "2", "--deepspeed", "--deepspeed_config", "foo.json"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_deprecated_deepscale_spellings():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--deepscale", "--deepscale_config", "bar.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "bar.json"
