@@ -1,0 +1,145 @@
+"""Data loading: host-side batching + device placement over the data axis.
+
+TPU-native analog of /root/reference/deepspeed/pt/deepspeed_dataloader.py:
+``DeepSpeedDataLoader`` there wraps a torch DataLoader with an automatic
+``DistributedSampler`` (one shard of every batch per DP rank, :23-31) and hooks
+the throughput timer on ``__next__`` (:53-56).  Here the loader produces the
+*global* batch as a ``jax.Array`` sharded over the mesh's ``data`` axis — each
+device receives only its shard, which is the DistributedSampler contract
+expressed as sharding instead of per-rank iteration.
+
+Dataset protocol: anything indexable with ``len()`` whose items are pytrees of
+numpy-convertible leaves (tuples, dicts, arrays); or a pytree of full arrays
+with a leading sample axis.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.constants import ROUTE_TRAIN
+from deepspeed_tpu.parallel.topology import DATA_AXIS
+
+logger = logging.getLogger(__name__)
+
+
+def default_collate(samples):
+    """Stack a list of pytree samples into a batch pytree (np.stack per leaf)."""
+    first = samples[0]
+    return jax.tree_util.tree_map(lambda *leaves: np.stack(leaves), first,
+                                  *samples[1:])
+
+
+class DeepSpeedDataLoader:
+    """Sharded batch iterator.
+
+    Args:
+      dataset: indexable dataset (see module docstring).
+      batch_size: GLOBAL batch per step (= micro_batch_per_rank * dp_size),
+        matching the reference where the sampler splits each global batch
+        across ranks.
+      mesh: engine mesh; batches are sharded over its ``data`` axis.  None =>
+        host-local numpy batches (no device placement), useful for tests.
+      route: 'train' shuffles each epoch (RandomSampler/DistributedSampler
+        shuffle); other routes are sequential (reference
+        deepspeed_light.py:546-556 uses SequentialSampler for eval/predict).
+      tput_timer: optional ThroughputTimer; ``start()`` is called on every
+        ``__next__`` like the reference hooks it (deepspeed_dataloader.py:53-56).
+      drop_last: drop the trailing ragged batch (default True: global batches
+        must be shardable over the data axis).
+    """
+
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 mesh: Optional[Mesh] = None,
+                 route: str = ROUTE_TRAIN,
+                 collate_fn: Optional[Callable] = None,
+                 tput_timer=None,
+                 seed: int = 0,
+                 drop_last: bool = True,
+                 local_rank: int = -1):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.mesh = mesh
+        self.route = route
+        self.collate_fn = collate_fn or default_collate
+        self.tput_timer = tput_timer
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.local_rank = local_rank
+
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        n = len(dataset)
+        if drop_last:
+            self.len = n // self.batch_size
+        else:
+            self.len = (n + self.batch_size - 1) // self.batch_size
+        self._sharding = None
+        if mesh is not None:
+            self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def set_epoch(self, epoch: int) -> None:
+        """DistributedSampler.set_epoch equivalent: reseeds the shuffle."""
+        self.epoch = int(epoch)
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.route == ROUTE_TRAIN:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def _place(self, batch):
+        """Shard the stacked numpy batch over the data axis."""
+        if self._sharding is None:
+            return batch
+
+        def put(leaf):
+            leaf = np.asarray(leaf)
+            spec = P(DATA_AXIS) if leaf.ndim >= 1 else P()
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def __len__(self) -> int:
+        return self.len
+
+    def __iter__(self) -> Iterator[Any]:
+        idx = self._indices()
+        nb = self.len
+        for b in range(nb):
+            if self.tput_timer is not None:
+                self.tput_timer.start()
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            samples = [self.dataset[int(i)] for i in sel]
+            batch = self.collate_fn(samples)
+            yield self._place(batch)
+        self.epoch += 1
+
+
+class ArrayDataset:
+    """Adapter: a pytree of arrays with leading sample axis -> indexable
+    dataset (the reference tests build tensor datasets the same way,
+    tests/unit/simple_model.py:44-52)."""
+
+    def __init__(self, *arrays):
+        self.arrays = [np.asarray(a) for a in arrays]
+        n = len(self.arrays[0])
+        if any(len(a) != n for a in self.arrays):
+            raise ValueError("all arrays must share the leading dimension")
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        out = tuple(a[i] for a in self.arrays)
+        return out if len(out) > 1 else out[0]

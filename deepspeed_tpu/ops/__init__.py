@@ -1,0 +1,9 @@
+from deepspeed_tpu.ops.optim import (  # noqa: F401
+    Adam,
+    AdamW,
+    Lamb,
+    Sgd,
+    Optimizer,
+    OptimizerState,
+    from_config,
+)

@@ -1,0 +1,9 @@
+from deepspeed_tpu.parallel.topology import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshConfig,
+    get_mesh,
+    make_mesh,
+    init_distributed,
+    mpi_discovery,
+)

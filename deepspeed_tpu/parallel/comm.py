@@ -1,0 +1,114 @@
+"""Collective wrappers with the reference's communication-tuning knobs.
+
+The reference's engine-level bucketed allreduce
+(/root/reference/deepspeed/pt/deepspeed_light.py:819-882) packs grads into
+≤500 MB flat buckets, optionally upcasts to fp32 (``fp32_allreduce``), and
+either pre-scales grads by 1/world before the reduce (``prescale_gradients``,
+with ``gradient_predivide_factor``) or post-scales after.  On TPU the bucketing
+is unnecessary — XLA fuses and schedules collectives — but the *semantics*
+(reduce dtype, pre/post scaling order) are preserved here as explicit
+``lax.psum`` wrappers used inside the shard_mapped train step, so results are
+bitwise-controlled the same way the reference controls NCCL.
+
+All functions take pytrees and an axis name; they must be called inside
+``jax.shard_map`` (or ``pjit`` with manual axes) over the engine mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tree_map(f, tree):
+    return jax.tree_util.tree_map(f, tree, is_leaf=lambda x: x is None)
+
+
+def allreduce_grads(grads,
+                    axis_name: str,
+                    world_size: int,
+                    fp32_allreduce: bool = False,
+                    prescale_gradients: bool = False,
+                    gradient_predivide_factor: float = 1.0):
+    """Sum-reduce grads over the DP axis and average.
+
+    Mirrors ``allreduce_bucket`` (reference deepspeed_light.py:819-849):
+      * ``fp32_allreduce``: upcast before the reduce (reference :822-825).
+      * prescale: divide by ``gradient_predivide_factor`` before the reduce,
+        then by ``world/predivide`` after (reference :827-838).
+      * postscale (default): reduce, then divide by world size.
+    The reduction itself lowers to an ICI all-reduce.
+    """
+    def reduce_one(g):
+        if g is None:
+            return None
+        orig_dtype = g.dtype
+        if fp32_allreduce:
+            g = g.astype(jnp.float32)
+        if prescale_gradients:
+            if gradient_predivide_factor != 1.0:
+                g = g / gradient_predivide_factor
+            g = lax.psum(g, axis_name)
+            if gradient_predivide_factor != world_size:
+                g = g / (world_size / gradient_predivide_factor)
+        else:
+            g = lax.psum(g, axis_name)
+            g = g / world_size
+        if fp32_allreduce and orig_dtype != jnp.float32:
+            g = g.astype(orig_dtype)
+        return g
+
+    return _tree_map(reduce_one, grads)
+
+
+def reduce_scatter_grads(flat_grad: jnp.ndarray,
+                         axis_name: str,
+                         world_size: int,
+                         fp32_allreduce: bool = False,
+                         prescale_gradients: bool = False,
+                         gradient_predivide_factor: float = 1.0) -> jnp.ndarray:
+    """Reduce-scatter a flat gradient over the DP axis, returning this rank's
+    partition (flat_grad length must be divisible by world_size).
+
+    The reference's ZeRO-1 reduces the *full* grad then frees non-owned slices
+    (zero_optimizer.py:370-384); the reduce-scatter formulation moves half the
+    bytes and was the reference's own roadmap item
+    (docs/_posts/2020-03-17-reduce-scatter.md).  Same scaling knobs as
+    ``allreduce_grads``.
+    """
+    g = flat_grad
+    orig_dtype = g.dtype
+    if fp32_allreduce:
+        g = g.astype(jnp.float32)
+    if prescale_gradients:
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True)
+        if gradient_predivide_factor != world_size:
+            g = g / (world_size / gradient_predivide_factor)
+    else:
+        g = lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True)
+        g = g / world_size
+    if fp32_allreduce and orig_dtype != jnp.float32:
+        g = g.astype(orig_dtype)
+    return g
+
+
+def allgather_params(partition: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Gather updated weight partitions from all DP ranks (flat, tiled) —
+    the ZeRO-1 weight allgather (reference zero_optimizer.py:397-432)."""
+    return lax.all_gather(partition, axis_name, axis=0, tiled=True)
+
+
+def overflow_any(local_overflow, axis_name: Optional[str]):
+    """MAX-allreduce of the overflow flag so all ranks agree
+    (reference deepspeed_utils.py:62-75 does this over the MP group; under
+    SPMD every axis sees the same global grads after reduction, but the local
+    pre-reduction check still needs agreement over DP)."""
+    f = jnp.asarray(local_overflow, jnp.float32)
+    if axis_name is not None:
+        f = lax.pmax(f, axis_name)
+    return f > 0

@@ -1,0 +1,223 @@
+"""Mixed precision: loss scaling state machines + overflow detection.
+
+TPU-native analog of /root/reference/deepspeed/pt/loss_scaler.py and the inline
+FSMs in fp16_optimizer.py:245-272 / fp16_unfused_optimizer.py.  On TPU the
+default precision is bf16, which needs no loss scaling; the fp16 dynamic-scale
+path is kept for parity and for fp16 workloads.
+
+Everything here is a pure function over a tiny ``LossScaleState`` pytree of
+scalar jnp arrays, so the whole FSM folds into the jitted train step with no
+host synchronisation: the overflow flag is a device scalar, the scale update is
+``jnp.where`` arithmetic, and "skip the update on overflow" is a ``where`` over
+the parameter update (reference zeroes grads and skips the step imperatively,
+deepspeed_zero_optimizer.py:349-359).
+
+Two FSM variants exist in the reference and both are preserved exactly:
+
+* ``update_loss_scale(..., variant=INLINE)`` — the FP16_Optimizer /
+  FP16_UnfusedOptimizer inline FSM (fp16_optimizer.py:245-272): halve on every
+  overflow (floored at min_scale); double when the post-overflow stable
+  interval ``(cur_iter - last_overflow_iter) - 1`` is a positive multiple of
+  ``scale_window``.  No hysteresis.
+* ``update_loss_scale(..., variant=MEGATRON)`` — ``DynamicLossScaler``
+  (loss_scaler.py:143-167), used by the ZeRO wrapper: ``delayed_shift``
+  hysteresis absorbs the first overflows; doubling when
+  ``(cur_iter - last_overflow_iter) % scale_window == 0``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Keys of the dynamic_loss_scale_args dict (reference loss_scaler.py:21-24)
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+# FSM variants
+INLINE = "inline"          # fp16_optimizer.py:245-272
+MEGATRON = "megatron"      # loss_scaler.py:143-167 (DynamicLossScaler)
+
+
+class LossScaleState(NamedTuple):
+    """Scalar-leaf pytree; lives on device inside the train step."""
+    cur_scale: jnp.ndarray          # f32 []
+    cur_iter: jnp.ndarray           # i32 []
+    last_overflow_iter: jnp.ndarray  # i32 []
+    cur_hysteresis: jnp.ndarray     # i32 [] (MEGATRON variant only)
+    # static config carried in the state for checkpointing convenience
+    scale_factor: jnp.ndarray       # f32 []
+    scale_window: jnp.ndarray       # i32 []
+    min_scale: jnp.ndarray          # f32 []
+    delayed_shift: jnp.ndarray      # i32 []
+    dynamic: jnp.ndarray            # bool []
+
+
+def make_loss_scale_state(init_scale: float = 2.0 ** 32,
+                          scale_factor: float = 2.0,
+                          scale_window: int = 1000,
+                          min_scale: float = 1.0,
+                          delayed_shift: int = 1,
+                          dynamic: bool = True) -> LossScaleState:
+    """Initial state (reference loss_scaler.py:96-112: cur_iter=0,
+    last_overflow_iter=-1)."""
+    f32 = lambda v: jnp.asarray(v, jnp.float32)
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    return LossScaleState(
+        cur_scale=f32(init_scale),
+        cur_iter=i32(0),
+        last_overflow_iter=i32(-1),
+        cur_hysteresis=i32(delayed_shift),
+        scale_factor=f32(scale_factor),
+        scale_window=i32(scale_window),
+        min_scale=f32(min_scale),
+        delayed_shift=i32(delayed_shift),
+        dynamic=jnp.asarray(dynamic, jnp.bool_),
+    )
+
+
+def static_loss_scale_state(scale: float) -> LossScaleState:
+    return make_loss_scale_state(init_scale=scale, dynamic=False)
+
+
+def from_dynamic_args(args: dict | None, initial_dynamic_scale: float = 2.0 ** 32,
+                      variant: str = INLINE) -> LossScaleState:
+    """Build state from a config ``dynamic_loss_scale_args`` dict.
+
+    Matches the per-wrapper defaults: fused path defaults to scale_window 1000
+    min 1 (fp16_optimizer.py:73-80); the MEGATRON variant honours
+    delayed_shift; the INLINE variant ignores it (reference inline FSM has no
+    hysteresis even though the config dict carries the key).
+    """
+    if args is None:
+        return make_loss_scale_state(init_scale=initial_dynamic_scale)
+    return make_loss_scale_state(
+        init_scale=args.get(INITIAL_LOSS_SCALE, initial_dynamic_scale),
+        scale_window=args.get(SCALE_WINDOW, 1000),
+        min_scale=args.get(MIN_LOSS_SCALE, 1.0),
+        delayed_shift=args.get(DELAYED_SHIFT, 1) if variant == MEGATRON else 1,
+    )
+
+
+# --------------------------------------------------------------------- updates
+
+def _inline_update(state: LossScaleState, overflow) -> LossScaleState:
+    """fp16_optimizer.py:245-272."""
+    halved = jnp.maximum(state.cur_scale / state.scale_factor, state.min_scale)
+    stable_interval = (state.cur_iter - state.last_overflow_iter) - 1
+    grow = jnp.logical_and(stable_interval > 0,
+                           stable_interval % state.scale_window == 0)
+    new_scale = jnp.where(
+        overflow, halved,
+        jnp.where(grow, state.cur_scale * state.scale_factor, state.cur_scale))
+    return state._replace(
+        cur_scale=jnp.where(state.dynamic, new_scale, state.cur_scale),
+        last_overflow_iter=jnp.where(overflow, state.cur_iter,
+                                     state.last_overflow_iter),
+        cur_iter=state.cur_iter + 1,
+    )
+
+
+def _megatron_update(state: LossScaleState, overflow) -> LossScaleState:
+    """loss_scaler.py:143-167 (consecutive_hysteresis=False as the reference
+    constructs it)."""
+    # overflow branch
+    shift_exhausted = jnp.logical_or(state.delayed_shift == 1,
+                                     state.cur_hysteresis == 1)
+    halved = jnp.maximum(state.cur_scale / state.scale_factor, state.min_scale)
+    scale_on_overflow = jnp.where(shift_exhausted, halved, state.cur_scale)
+    hyst_on_overflow = jnp.where(shift_exhausted, state.cur_hysteresis,
+                                 state.cur_hysteresis - 1)
+    # clean branch
+    grow = (state.cur_iter - state.last_overflow_iter) % state.scale_window == 0
+    scale_on_clean = jnp.where(grow, state.cur_scale * state.scale_factor,
+                               state.cur_scale)
+    hyst_on_clean = jnp.where(grow, state.delayed_shift, state.cur_hysteresis)
+
+    new_scale = jnp.where(overflow, scale_on_overflow, scale_on_clean)
+    return state._replace(
+        cur_scale=jnp.where(state.dynamic, new_scale, state.cur_scale),
+        cur_hysteresis=jnp.where(
+            state.dynamic,
+            jnp.where(overflow, hyst_on_overflow, hyst_on_clean),
+            state.cur_hysteresis),
+        last_overflow_iter=jnp.where(overflow, state.cur_iter,
+                                     state.last_overflow_iter),
+        cur_iter=state.cur_iter + 1,
+    )
+
+
+def update_loss_scale(state: LossScaleState, overflow,
+                      variant: str = INLINE) -> LossScaleState:
+    """One FSM transition.  ``overflow`` may be a python bool or a device
+    scalar; ``variant`` is static (selected at trace time)."""
+    overflow = jnp.asarray(overflow, jnp.bool_)
+    if variant == INLINE:
+        return _inline_update(state, overflow)
+    elif variant == MEGATRON:
+        return _megatron_update(state, overflow)
+    raise ValueError(f"unknown loss-scale variant {variant!r}")
+
+
+# ---------------------------------------------------------------- overflow
+
+def has_overflow(grads) -> jnp.ndarray:
+    """True if any grad leaf contains inf/nan.
+
+    Reference probes via a float sum per tensor (loss_scaler.py:122-140) then
+    allreduces MAX over the model-parallel group (deepspeed_utils.py:62-75).
+    Under pjit the grads are already global arrays, so a single fused
+    ``isfinite`` reduction is the whole check — no collective, no host sync.
+    """
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if g is not None]
+    if not leaves:
+        return jnp.asarray(False)
+    finite = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.logical_not(jnp.stack(finite).all())
+
+
+def scale_loss(loss, state: LossScaleState):
+    """loss * cur_scale in fp32 (reference fp16_optimizer.py:242-243)."""
+    return jnp.asarray(loss, jnp.float32) * state.cur_scale
+
+
+def unscale(tree, state: LossScaleState):
+    """Divide every grad leaf by the current scale (fp32 math)."""
+    inv = 1.0 / state.cur_scale
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv) if g is not None else None, tree)
+
+
+def combined_unscale_and_clip_factor(total_norm, state: LossScaleState,
+                                     clip_grad: float):
+    """The combined scale used to unscale+clip in one multiply
+    (reference fp16_optimizer.py:221-228, zero_optimizer.py:443-458):
+    grads /= combined_scale where combined_scale = scale, or scale*clip_ratio
+    when the unscaled norm exceeds clip_grad.  total_norm is the norm of the
+    *scaled* grads."""
+    combined = state.cur_scale
+    if clip_grad > 0.0:
+        clip = ((total_norm / state.cur_scale) + 1e-6) / clip_grad
+        combined = jnp.where(clip > 1.0, clip * state.cur_scale, combined)
+    return combined
+
+
+# ----------------------------------------------------------------- policies
+
+class Policy(NamedTuple):
+    """Dtype policy: params live in fp32 masters; compute/grads in
+    ``compute_dtype``.  bf16 is the TPU default (MXU-native, no loss scale)."""
+    compute_dtype: jnp.dtype
+    needs_loss_scale: bool
+
+
+def policy_from_config(fp16_enabled: bool, bf16_enabled: bool) -> Policy:
+    if fp16_enabled:
+        return Policy(jnp.float16, True)
+    if bf16_enabled:
+        return Policy(jnp.bfloat16, False)
+    return Policy(jnp.float32, False)

@@ -1,0 +1,4 @@
+from deepspeed_tpu.utils.timer import (  # noqa: F401
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)

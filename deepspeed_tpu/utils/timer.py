@@ -1,0 +1,168 @@
+"""Wall-clock + throughput timers.
+
+TPU-native analog of /root/reference/deepspeed/pt/deepspeed_timer.py.  The
+reference fences with ``torch.cuda.synchronize()`` on every start/stop
+(deepspeed_timer.py:32-40); under JAX's async dispatch the equivalent is
+blocking on the arrays produced by the span being measured, so ``stop()``
+accepts an optional ``sync_on`` pytree to ``block_until_ready`` — fencing only
+what was actually computed instead of the whole device stream.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+try:
+    import psutil
+    PSUTIL_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PSUTIL_AVAILABLE = False
+
+
+def _fence(sync_on) -> None:
+    if sync_on is not None:
+        for leaf in jax.tree_util.tree_leaves(sync_on):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+
+
+class SynchronizedWallClockTimer:
+    """Named span timers (reference deepspeed_timer.py:19-79)."""
+
+    class Timer:
+        def __init__(self, name: str):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = time.time()
+
+        def start(self, sync_on=None):
+            assert not self.started_, f"timer {self.name_} has already started"
+            _fence(sync_on)
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, sync_on=None):
+            assert self.started_, f"timer {self.name_} is not started"
+            _fence(sync_on)
+            self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset: bool = True) -> float:
+            started = self.started_
+            if started:
+                self.stop()
+            e = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return e
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage() -> str:
+        if not PSUTIL_AVAILABLE:
+            return ""
+        vm = psutil.virtual_memory()
+        return f"host mem used {vm.used / 2**30:.2f} GB ({vm.percent}%)"
+
+    def log(self, names, normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False):
+        """Grouped ms printout (reference deepspeed_timer.py:72-79)."""
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0
+                string += f" | {name}: {elapsed / normalizer:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        logger.info(string)
+        return string
+
+
+class ThroughputTimer:
+    """Samples/sec reporter (reference deepspeed_timer.py:82-156)."""
+
+    def __init__(self,
+                 batch_size: int,
+                 num_workers: int = 1,
+                 start_step: int = 2,
+                 steps_per_output: int = 50,
+                 monitor_memory: bool = False,
+                 logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.local_step_count = 0
+        self.total_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory and PSUTIL_AVAILABLE
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.local_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.total_step_count >= self.start_step:
+            self.start_time = time.time()
+
+    def stop(self, report_speed: bool = True, sync_on=None):
+        if not self.started:
+            return
+        self.started = False
+        self.total_step_count += 1
+        self.local_step_count += 1
+        if self.total_step_count > self.start_step:
+            _fence(sync_on)
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.local_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"{self.epoch_count}/{self.local_step_count}, "
+                    f"SamplesPerSec={self.avg_samples_per_sec():.3f}")
+                if self.monitor_memory:
+                    vm = psutil.virtual_memory()
+                    self.logging(
+                        f"{self.epoch_count}/{self.local_step_count}, "
+                        f"vm percent: {vm.percent}, swap percent: "
+                        f"{psutil.swap_memory().percent}")
+
+    def avg_samples_per_sec(self) -> float:
+        if self.total_step_count > self.start_step:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.total_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            return samples_per_step / avg_time_per_step
+        return float("-inf")

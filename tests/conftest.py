@@ -4,13 +4,27 @@ The reference tests "multi-node" semantics by forking N local processes
 (/root/reference/tests/unit/common.py:14-100).  On TPU/XLA we get the same
 coverage cheaper: ``--xla_force_host_platform_device_count=8`` gives 8 fake
 devices in one process, so sharding, ZeRO partition math and collectives all
-execute for real.  Must be set before jax initializes.
+execute for real.
+
+Environment wrinkle: this image's sitecustomize registers the experimental
+``axon`` TPU PJRT plugin at interpreter start (PALLAS_AXON_POOL_IPS set), and
+once registered, selecting the cpu platform hangs.  The registration guard is
+the env var, so the only reliable way to get a CPU-only test interpreter is to
+re-exec with the var cleared before python starts.  This makes a plain
+``python -m pytest tests/`` work regardless of the caller's environment.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+if os.environ.get("_DSTPU_TEST_ENV") != "1":
+    env = dict(os.environ)
+    env["_DSTPU_TEST_ENV"] = "1"
+    env["PALLAS_AXON_POOL_IPS"] = ""      # skip axon PJRT registration
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env.setdefault("JAX_ENABLE_X64", "0")
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

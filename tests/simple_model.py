@@ -1,0 +1,77 @@
+"""Test models + helpers (analog of /root/reference/tests/unit/simple_model.py)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.data import ArrayDataset
+
+
+class SimpleModel:
+    """1 Linear + cross-entropy, returning the loss from forward — the same
+    shape as the reference SimpleModel (simple_model.py:7-18), which the
+    reference tests drive via ``loss = model(x, y)``."""
+
+    def __init__(self, hidden_dim: int, empty_grad: bool = False):
+        self.hidden_dim = hidden_dim
+        self.empty_grad = empty_grad
+
+    def init_params(self, rng):
+        k1, _ = jax.random.split(jax.random.PRNGKey(0) if rng is None else rng)
+        params = {
+            "w": jax.random.normal(k1, (self.hidden_dim, self.hidden_dim),
+                                   jnp.float32) * 0.1,
+            "b": jnp.zeros((self.hidden_dim,), jnp.float32),
+        }
+        if self.empty_grad:
+            # a parameter the loss never touches (reference's never-used
+            # second Linear exercising p.grad is None)
+            params["unused"] = jnp.zeros((self.hidden_dim,), jnp.float32)
+        return params
+
+    def apply(self, params, x, y):
+        logits = x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        onehot = jax.nn.one_hot(y, self.hidden_dim, dtype=jnp.float32)
+        return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+class LinearSumModel:
+    """loss = mean(w * x): grads equal mean(x), so injecting inf/nan data
+    injects inf/nan *gradients* — the engine-level equivalent of the
+    reference's run_model_step writing into p.grad
+    (test_dynamic_loss_scale.py:12-17)."""
+
+    def __init__(self, dim: int = 4):
+        self.dim = dim
+
+    def init_params(self, rng):
+        return {"w": jnp.ones((self.dim,), jnp.float32)}
+
+    def apply(self, params, x):
+        return jnp.mean(params["w"].astype(x.dtype) * x)
+
+
+def random_dataset(total_samples, hidden_dim, num_classes=None, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    y = rng.integers(0, num_classes or hidden_dim,
+                     size=(total_samples,)).astype(np.int32)
+    return ArrayDataset(x, y)
+
+
+def args_from_dict(tmpdir, config_dict):
+    """Write the config json and build an argparse-like namespace (reference
+    simple_model.py args_from_dict)."""
+    import argparse
+    config_path = str(tmpdir.join("config.json"))
+    with open(config_path, "w") as f:
+        json.dump(config_dict, f)
+    args = argparse.Namespace()
+    args.deepspeed = True
+    args.deepspeed_config = config_path
+    args.local_rank = 0
+    args.deepspeed_mpi = False
+    return args

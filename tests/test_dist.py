@@ -1,0 +1,132 @@
+"""Mesh + collectives smoke tests.
+
+Equivalent of /root/reference/tests/unit/test_dist.py (init, allreduce
+correctness vs closed form) on the 8-fake-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel import comm, topology
+
+
+def test_eight_fake_devices():
+    assert len(jax.devices()) == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_make_mesh_shapes():
+    mesh = topology.make_mesh(model_parallel_size=2)
+    assert topology.data_parallel_size(mesh) == 4
+    assert topology.model_parallel_size(mesh) == 2
+    mesh = topology.make_mesh()
+    assert topology.data_parallel_size(mesh) == 8
+    with pytest.raises(ValueError):
+        topology.make_mesh(model_parallel_size=3)
+
+
+def test_allreduce_matches_closed_form():
+    # world of 8, each rank contributes rank+1; sum = 36, mean = 4.5
+    mesh = topology.make_mesh()
+    x = jnp.arange(1.0, 9.0)  # global array, one value per rank
+
+    def body(xs):
+        g = {"w": xs}
+        out = comm.allreduce_grads(g, topology.DATA_AXIS, world_size=8)
+        return out["w"]
+
+    y = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=P(topology.DATA_AXIS),
+                              out_specs=P(topology.DATA_AXIS)))(x)
+    np.testing.assert_allclose(np.asarray(y), np.full((8,), 4.5))
+
+
+def test_allreduce_prescale_matches_postscale():
+    mesh = topology.make_mesh()
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def run(**kw):
+        def body(xs):
+            return comm.allreduce_grads({"w": xs}, topology.DATA_AXIS,
+                                        world_size=8, **kw)["w"]
+        return np.asarray(jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P(topology.DATA_AXIS),
+            out_specs=P(topology.DATA_AXIS)))(x))
+
+    post = run()
+    pre = run(prescale_gradients=True, gradient_predivide_factor=1.0)
+    half = run(prescale_gradients=True, gradient_predivide_factor=2.0)
+    np.testing.assert_allclose(post, pre, rtol=1e-6)
+    np.testing.assert_allclose(post, half, rtol=1e-6)
+
+
+def test_fp32_allreduce_upcasts():
+    mesh = topology.make_mesh()
+    # bf16 inputs whose exact sum needs more than bf16 mantissa
+    x = jnp.full((8, 4), 1.001, jnp.bfloat16)
+
+    def body(xs):
+        out = comm.allreduce_grads({"w": xs}, topology.DATA_AXIS, world_size=8,
+                                   fp32_allreduce=True)
+        return out["w"]
+
+    y = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=P(topology.DATA_AXIS),
+                              out_specs=P(topology.DATA_AXIS)))(x)
+    assert y.dtype == jnp.bfloat16  # cast back after fp32 reduce
+
+
+def test_reduce_scatter_then_allgather_roundtrip():
+    mesh = topology.make_mesh()
+    world = 8
+    n = 64
+    # every rank holds the same flat grad; reduce-scatter then allgather must
+    # equal the allreduced mean
+    flat = jnp.arange(float(n))
+    stacked = jnp.tile(flat, (world, 1))  # [world, n] sharded over data
+
+    def body(local):
+        # local: [1, n] this rank's copy
+        part = comm.reduce_scatter_grads(local[0], topology.DATA_AXIS, world)
+        full = comm.allgather_params(part, topology.DATA_AXIS)
+        return full[None]
+
+    y = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=P(topology.DATA_AXIS, None),
+                              out_specs=P(topology.DATA_AXIS, None)))(stacked)
+    np.testing.assert_allclose(np.asarray(y[0]), np.arange(float(n)), rtol=1e-6)
+
+
+def test_overflow_any_agrees_across_ranks():
+    mesh = topology.make_mesh()
+    # rank 3 sees an overflow; everyone must agree
+    flags = jnp.zeros((8,)).at[3].set(1.0)
+
+    def body(f):
+        return jnp.asarray(
+            comm.overflow_any(f[0] > 0, topology.DATA_AXIS), jnp.float32)[None]
+
+    y = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=P(topology.DATA_AXIS),
+                              out_specs=P(topology.DATA_AXIS)))(flags)
+    np.testing.assert_array_equal(np.asarray(y), np.ones((8,)))
+
+
+def test_mpi_discovery(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "16")
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.5")
+    monkeypatch.setenv("MASTER_PORT", "12345")
+    info = topology.mpi_discovery()
+    assert info == {"rank": 3, "world_size": 16,
+                    "coordinator_address": "10.0.0.5:12345"}
+
+
+def test_mpi_discovery_missing(monkeypatch):
+    for v in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_PROCID"):
+        monkeypatch.delenv(v, raising=False)
+    with pytest.raises(RuntimeError):
+        topology.mpi_discovery()

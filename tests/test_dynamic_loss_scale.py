@@ -1,0 +1,151 @@
+"""Loss-scale FSM trajectories.
+
+FSM-level equivalent of /root/reference/tests/unit/test_dynamic_loss_scale.py:
+the reference injects inf/nan/uniform grads into a live engine and asserts the
+exact scale trajectory; here the FSM is a pure function so the same
+trajectories are asserted directly (the engine-level version is covered again
+in test_fp16.py once an engine is in the loop).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import precision as P
+
+
+def steps(state, overflows, variant):
+    """Run the FSM over a list of overflow booleans, returning the state after
+    each transition."""
+    out = []
+    fsm = jax.jit(lambda s, o: P.update_loss_scale(s, o, variant=variant),
+                  static_argnames=())
+    for o in overflows:
+        state = P.update_loss_scale(state, o, variant=variant)
+        out.append(state)
+    return out
+
+
+@pytest.mark.parametrize("variant", [P.INLINE, P.MEGATRON])
+def test_no_overflow_doubling(variant):
+    # initial_scale_power 8, window 2 (reference test_fused_no_overflow)
+    state = P.make_loss_scale_state(init_scale=2 ** 8, scale_window=2)
+    expected = 2.0 ** 8
+    for i, st in enumerate(steps(state, [False] * 10, variant)):
+        if variant == P.INLINE:
+            assert float(st.cur_scale) == expected
+            assert int(st.cur_iter) == i + 1
+            if int(st.cur_iter) % 2 == 0:
+                expected *= 2
+        else:
+            # MEGATRON doubles when (cur_iter - (-1)) % window == 0: iters 1,3,5...
+            pass
+    if variant == P.MEGATRON:
+        st = steps(P.make_loss_scale_state(init_scale=2 ** 8, scale_window=2),
+                   [False] * 4, variant)
+        # transition at cur_iter=1 -> (1-(-1))%2==0 -> double; cur_iter=3 -> double
+        assert [float(s.cur_scale) for s in st] == [256.0, 512.0, 512.0, 1024.0]
+
+
+def test_inline_all_overflow_floor():
+    # initial 2**4, every step overflows: halve to floor 1
+    # (reference test_fused_all_overflow)
+    state = P.make_loss_scale_state(init_scale=2 ** 4, scale_window=2)
+    expected = 2.0 ** 4
+    for i, st in enumerate(steps(state, [True] * 8, P.INLINE)):
+        expected = max(expected / 2, 1.0)
+        assert float(st.cur_scale) == expected
+        assert int(st.cur_iter) == i + 1
+
+
+def test_inline_all_overflow_custom_min():
+    # min_loss_scale 0.25 honored (reference test_unfused_all_overflow)
+    state = P.make_loss_scale_state(init_scale=2 ** 4, scale_window=2,
+                                    min_scale=0.25)
+    expected = 2.0 ** 4
+    for st in steps(state, [True] * 8, P.INLINE):
+        expected = max(expected / 2, 0.25)
+        assert float(st.cur_scale) == expected
+
+
+def test_inline_some_overflow():
+    # reference test_fused_some_overflow: 2 overflows, window+1 clean, 1 overflow
+    state = P.make_loss_scale_state(init_scale=2 ** 8, scale_window=2)
+    scale = 2.0 ** 8
+    hist = steps(state, [True, True] + [False] * 3 + [True], P.INLINE)
+    # two overflows: /4
+    assert float(hist[1].cur_scale) == scale / 4
+    # window+1 clean steps: one doubling
+    assert float(hist[4].cur_scale) == scale / 2
+    # final overflow: halve again
+    assert float(hist[5].cur_scale) == scale / 4
+    assert int(hist[5].cur_iter) == 6
+
+
+def test_megatron_hysteresis():
+    # delayed_shift=2: first overflow only burns hysteresis, second halves
+    # (reference loss_scaler.py:153-159)
+    state = P.make_loss_scale_state(init_scale=2 ** 8, scale_window=1000,
+                                    delayed_shift=2)
+    hist = steps(state, [True, True, True], P.MEGATRON)
+    assert float(hist[0].cur_scale) == 2.0 ** 8      # hysteresis absorbed
+    assert int(hist[0].cur_hysteresis) == 1
+    assert float(hist[1].cur_scale) == 2.0 ** 7      # now halves
+    assert float(hist[2].cur_scale) == 2.0 ** 6      # keeps halving
+
+
+def test_static_scale_never_moves():
+    state = P.static_loss_scale_state(128.0)
+    for st in steps(state, [True, False, True, False], P.INLINE):
+        assert float(st.cur_scale) == 128.0
+    assert int(st.cur_iter) == 4
+
+
+def test_fsm_is_jittable():
+    state = P.make_loss_scale_state(init_scale=2 ** 8, scale_window=2)
+    step = jax.jit(lambda s, o: P.update_loss_scale(s, o, variant=P.INLINE))
+    st = step(state, jnp.asarray(True))
+    assert float(st.cur_scale) == 2.0 ** 7
+
+
+def test_has_overflow():
+    good = {"a": jnp.ones((4, 4)), "b": jnp.zeros((3,))}
+    assert not bool(P.has_overflow(good))
+    bad = {"a": jnp.ones((4, 4)), "b": jnp.array([1.0, jnp.inf, 0.0])}
+    assert bool(P.has_overflow(bad))
+    nan = {"a": jnp.array([jnp.nan]), "b": None}
+    assert bool(P.has_overflow(nan))
+    assert not bool(P.has_overflow({}))
+
+
+def test_scale_and_unscale_roundtrip():
+    state = P.make_loss_scale_state(init_scale=1024.0)
+    loss = jnp.asarray(2.5, jnp.float16)
+    scaled = P.scale_loss(loss, state)
+    assert scaled.dtype == jnp.float32
+    assert float(scaled) == 2.5 * 1024.0
+    grads = {"w": jnp.full((8,), 512.0, jnp.float16)}
+    un = P.unscale(grads, state)
+    np.testing.assert_allclose(np.asarray(un["w"]), 0.5)
+
+
+def test_combined_unscale_and_clip():
+    state = P.make_loss_scale_state(init_scale=4.0)
+    # unscaled norm 10, clip 1.0 -> combined ≈ 10*4
+    c = P.combined_unscale_and_clip_factor(jnp.asarray(40.0), state, 1.0)
+    np.testing.assert_allclose(float(c), (10.0 + 1e-6 / 4 * 4) * 4.0, rtol=1e-5)
+    # norm below clip threshold -> plain scale
+    c = P.combined_unscale_and_clip_factor(jnp.asarray(2.0), state, 1.0)
+    assert float(c) == 4.0
+    # clipping disabled
+    c = P.combined_unscale_and_clip_factor(jnp.asarray(1e9), state, 0.0)
+    assert float(c) == 4.0
+
+
+def test_policy_selection():
+    assert P.policy_from_config(True, False).compute_dtype == jnp.float16
+    assert P.policy_from_config(True, False).needs_loss_scale
+    assert P.policy_from_config(False, True).compute_dtype == jnp.bfloat16
+    assert not P.policy_from_config(False, True).needs_loss_scale
+    assert P.policy_from_config(False, False).compute_dtype == jnp.float32

@@ -1,0 +1,295 @@
+"""Engine + optimizer integration tests.
+
+Behavioral equivalent of /root/reference/tests/unit/test_fp16.py: fp16
+training paths for Adam/LAMB, ZeRO assertions, scheduler compatibility, and
+the engine-level dynamic-loss-scale trajectories of
+test_dynamic_loss_scale.py — all on the 8-fake-device CPU mesh.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfigError
+from simple_model import (LinearSumModel, SimpleModel, args_from_dict,
+                          random_dataset)
+
+HIDDEN = 16
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 32,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_training(model, config, steps=10, tmpdir=None, data_seed=0):
+    args = args_from_dict(tmpdir, config) if tmpdir else None
+    engine, optim, _, _ = deepspeed_tpu.initialize(
+        args=args, config=None if tmpdir else config, model=model,
+        model_parameters=model.init_params(None))
+    ds = random_dataset(64, HIDDEN, seed=data_seed)
+    dl = engine.deepspeed_io(ds)
+    losses = []
+    it = iter(dl)
+    for _ in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(dl)
+            batch = next(it)
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return engine, optim, losses
+
+
+def test_adam_fp16_basic(tmpdir):
+    engine, optim, losses = run_training(SimpleModel(HIDDEN),
+                                         base_config(), tmpdir=tmpdir)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert engine.global_steps == 10
+    assert optim.cur_scale == 2 ** 8  # no overflow on sane data
+
+
+def test_lamb_fp16_basic(tmpdir):
+    cfg = base_config(optimizer={"type": "Lamb", "params": {"lr": 0.002}})
+    engine, optim, losses = run_training(SimpleModel(HIDDEN), cfg,
+                                         steps=20, tmpdir=tmpdir)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_bf16_basic():
+    cfg = base_config()
+    del cfg["fp16"]
+    cfg["bf16"] = {"enabled": True}
+    engine, optim, losses = run_training(SimpleModel(HIDDEN), cfg)
+    assert losses[-1] < losses[0]
+    assert engine.params["w"].dtype == jnp.bfloat16
+
+
+def test_fp32_basic():
+    cfg = base_config()
+    del cfg["fp16"]
+    engine, optim, losses = run_training(SimpleModel(HIDDEN), cfg)
+    assert losses[-1] < losses[0]
+    assert engine.params["w"].dtype == jnp.float32
+
+
+def test_unfused_optimizer_static_scale_unsupported():
+    # reference: LAMB + static loss scale asserts (deepspeed_light.py:404-413)
+    cfg = base_config(optimizer={"type": "Lamb", "params": {"lr": 0.01}},
+                      fp16={"enabled": True, "loss_scale": 128})
+    with pytest.raises(DeepSpeedConfigError):
+        run_training(SimpleModel(HIDDEN), cfg, steps=1)
+
+
+def test_zero_static_loss_scale(tmpdir):
+    # reference test_fp16.py:253-279: ZeRO + static scale asserted
+    cfg = base_config(zero_optimization=True,
+                      fp16={"enabled": True, "loss_scale": 138.0})
+    engine, optim, losses = run_training(SimpleModel(HIDDEN), cfg,
+                                         tmpdir=tmpdir)
+    assert optim.loss_scale == 138.0
+    assert losses[-1] < losses[0]
+    assert engine.zero_enabled
+
+
+def test_zero_unsupported_optimizer_raises():
+    # reference test_fp16.py:294-317 (assertion for untested optimizers)
+    cfg = base_config(zero_optimization=True,
+                      optimizer={"type": "Lamb", "params": {"lr": 0.01}})
+    with pytest.raises(DeepSpeedConfigError):
+        run_training(SimpleModel(HIDDEN), cfg, steps=1)
+
+
+def test_zero_empty_partition():
+    # reference test_fp16.py:320-347: more DP ranks than parameter elements;
+    # with dp=8 a 2-element model leaves most partitions as pure padding
+    model = LinearSumModel(dim=2)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "zero_optimization": True,
+    }
+    engine, optim, _ = run_training_linear(model, cfg, steps=3)
+    assert engine.global_steps == 3
+
+
+def run_training_linear(model, config, steps=3):
+    engine, optim, _, _ = deepspeed_tpu.initialize(
+        config=config, model=model, model_parameters=model.init_params(None))
+    losses = []
+    for i in range(steps):
+        x = jnp.full((8, model.dim) if False else (8,), 0.1, jnp.float16)
+        # batch over data axis: shape [8] -> one scalar element per rank
+        loss = engine(x)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return engine, optim, losses
+
+
+def test_zero_matches_non_zero():
+    # same data, same seeds: ZeRO-1 partitioned Adam must track the replicated
+    # Adam closely (fp32 master math is identical; reduction order differs)
+    cfg_plain = base_config()
+    cfg_zero = base_config(zero_optimization=True)
+    m1 = SimpleModel(HIDDEN)
+    m2 = SimpleModel(HIDDEN)
+    e1, _, l1 = run_training(m1, cfg_plain, steps=5, data_seed=3)
+    e2, _, l2 = run_training(m2, cfg_zero, steps=5, data_seed=3)
+    np.testing.assert_allclose(l1, l2, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(e1.master["w"], np.float32),
+                               np.asarray(e2.params["w"], np.float32),
+                               rtol=2e-2, atol=2e-4)
+
+
+def test_scheduler_compat(tmpdir):
+    # reference test_fp16.py:147-248: named schedulers drive the engine lr
+    cfg = base_config(scheduler={
+        "type": "WarmupLR",
+        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                   "warmup_num_steps": 4}})
+    engine, optim, losses = run_training(SimpleModel(HIDDEN), cfg,
+                                         steps=6, tmpdir=tmpdir)
+    assert engine.lr_scheduler is not None
+    # after >4 boundary steps lr reached warmup_max_lr
+    assert optim.param_groups[0]["lr"] == pytest.approx(0.01)
+
+
+def test_gradient_accumulation_equivalence():
+    # gas=2 with half micro-batches must equal gas=1 on the same global batch
+    cfg1 = {"train_batch_size": 16, "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "fp16": {"enabled": True, "initial_scale_power": 4}}
+    cfg2 = {"train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "fp16": {"enabled": True, "initial_scale_power": 4}}
+    ds = random_dataset(16, HIDDEN, seed=5)
+    xs = np.stack([np.asarray(ds[i][0]) for i in range(16)])
+    ys = np.stack([np.asarray(ds[i][1]) for i in range(16)])
+
+    m = SimpleModel(HIDDEN)
+    e1, _, _ = _engine(m, cfg1)
+    loss = e1(jnp.asarray(xs), jnp.asarray(ys))
+    e1.backward(loss)
+    e1.step()
+
+    e2, _, _ = _engine(m, cfg2)
+    for half in (slice(0, 8), slice(8, 16)):
+        loss = e2(jnp.asarray(xs[half]), jnp.asarray(ys[half]))
+        e2.backward(loss)
+        e2.step()
+
+    assert e1.global_steps == 1 and e2.global_steps == 1
+    np.testing.assert_allclose(np.asarray(e1.master["w"]),
+                               np.asarray(e2.master["w"]), rtol=1e-3,
+                               atol=1e-5)
+
+
+def _engine(model, cfg):
+    engine, optim, dl, sched = deepspeed_tpu.initialize(
+        config=cfg, model=model, model_parameters=model.init_params(None))
+    return engine, optim, dl
+
+
+# ---------------------------------------------------------------- loss scale
+# engine-level trajectories (reference test_dynamic_loss_scale.py)
+
+def loss_scale_engine(initial_power=8, window=2, min_scale=1,
+                      optimizer="Adam"):
+    model = LinearSumModel(dim=8)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": optimizer, "params": {"lr": 0.00015}},
+        "fp16": {"enabled": True, "loss_scale": 0,
+                 "initial_scale_power": initial_power,
+                 "loss_scale_window": window,
+                 "min_loss_scale": min_scale},
+    }
+    engine, optim, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model, model_parameters=model.init_params(None))
+    return engine, optim
+
+
+def run_model_step(engine, values):
+    """Feed data whose gradient equals the value (inf/nan injection path)."""
+    for v in values:
+        x = jnp.full((8,), v, jnp.float32)
+        loss = engine(x)
+        engine.backward(loss)
+        engine.step()
+
+
+@pytest.mark.parametrize("optimizer", ["Adam", "Lamb"])
+def test_engine_no_overflow(optimizer):
+    engine, optim = loss_scale_engine(initial_power=8, window=2,
+                                      optimizer=optimizer)
+    expected_scale = 2 ** 8
+    expected_window = 2
+    assert optim.dynamic_loss_scale is True
+    assert optim.cur_scale == expected_scale
+    assert optim.scale_window == expected_window
+    rng = np.random.default_rng(0)
+    for i, value in enumerate(rng.uniform(-0.1, 0.1, 10)):
+        run_model_step(engine, [value])
+        assert optim.cur_scale == expected_scale
+        assert optim.cur_iter == (i + 1)
+        if optim.cur_iter % expected_window == 0:
+            expected_scale *= 2
+
+
+@pytest.mark.parametrize("optimizer", ["Adam", "Lamb"])
+def test_engine_all_overflow(optimizer):
+    engine, optim = loss_scale_engine(initial_power=4, window=2,
+                                      min_scale=0.25, optimizer=optimizer)
+    expected_scale = 2 ** 4
+    assert optim.cur_scale == expected_scale
+    overflow_values = [float("inf"), float("-inf")] + [float("nan")] * 6
+    for i, value in enumerate(overflow_values):
+        run_model_step(engine, [value])
+        expected_scale = max(expected_scale / 2, 0.25)
+        assert optim.cur_scale == expected_scale
+        assert optim.cur_iter == (i + 1)
+    assert engine.skipped_steps == len(overflow_values)
+
+
+def test_engine_some_overflow():
+    engine, optim = loss_scale_engine(initial_power=8, window=2)
+    expected_scale = 2 ** 8
+    expected_iteration = 0
+
+    overflow_values = [float("inf"), float("nan")]
+    expected_iteration += len(overflow_values)
+    run_model_step(engine, overflow_values)
+    expected_scale /= 2 ** len(overflow_values)
+    assert optim.cur_scale == expected_scale
+    assert optim.cur_iter == expected_iteration
+
+    rng = np.random.default_rng(1)
+    normal = rng.uniform(-0.1, 0.1, 3)  # window + 1
+    expected_iteration += len(normal)
+    run_model_step(engine, list(normal))
+    expected_scale *= 2
+    assert optim.cur_scale == expected_scale
+    assert optim.cur_iter == expected_iteration
+
+    run_model_step(engine, [float("inf")])
+    expected_iteration += 1
+    expected_scale /= 2
+    assert optim.cur_scale == expected_scale
+    assert optim.cur_iter == expected_iteration
+
+    # params never absorbed a non-finite update
+    assert np.all(np.isfinite(np.asarray(engine.master["w"])))

@@ -1,0 +1,144 @@
+"""LR schedule trajectories (reference deepspeed_lr_schedules.py behaviors)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import lr_schedules as L
+
+
+class Shim:
+    """Minimal param_groups holder (what the engine's optimizer exposes)."""
+    def __init__(self, lr=0.1, betas=(0.9, 0.999), groups=1):
+        self.param_groups = [{"lr": lr, "betas": betas} for _ in range(groups)]
+
+
+def test_warmup_lr_log_shape():
+    opt = Shim()
+    s = L.WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                   warmup_num_steps=100)
+    lrs = []
+    for _ in range(150):
+        s.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    # log-shaped: lr(t) = max_lr * log(t+1)/log(100) while warming
+    for t in (1, 10, 50):
+        expected = 0.001 * math.log(t + 1) / math.log(100)
+        np.testing.assert_allclose(lrs[t], expected, rtol=1e-9)
+    # constant at max after warmup
+    assert lrs[120] == 0.001
+    assert lrs[-1] == 0.001
+
+
+def test_warmup_lr_min_offset():
+    opt = Shim()
+    s = L.WarmupLR(opt, warmup_min_lr=0.0005, warmup_max_lr=0.001,
+                   warmup_num_steps=10)
+    s.step(10)
+    assert opt.param_groups[0]["lr"] == 0.001
+    s.step(0)
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.0005, rtol=1e-9)
+
+
+def test_lr_range_test_continuous():
+    opt = Shim()
+    s = L.LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                      lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0)
+    # construction applies min lr (reference :363-365)
+    assert opt.param_groups[0]["lr"] == 0.01
+    s.step(20)  # interval 2.0 -> lr = 0.01 * (1 + 2) = 0.03
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.03, rtol=1e-9)
+    s.step(5)   # continuous: interval 0.5 -> 0.015
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.015, rtol=1e-9)
+
+
+def test_lr_range_test_staircase():
+    opt = Shim()
+    s = L.LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                      lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0,
+                      lr_range_test_staircase=True)
+    s.step(5)   # floor(0.5) = 0 -> still min
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.01, rtol=1e-9)
+    s.step(15)  # floor(1.5) = 1 -> 0.02
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.02, rtol=1e-9)
+
+
+def test_one_cycle_triangular_and_momentum():
+    opt = Shim()
+    s = L.OneCycle(opt, cycle_min_lr=0.1, cycle_max_lr=0.3,
+                   cycle_first_step_size=10, cycle_momentum=True,
+                   cycle_min_mom=0.8, cycle_max_mom=0.9)
+    # at construction: min lr, min momentum
+    assert opt.param_groups[0]["lr"] == 0.1
+    assert opt.param_groups[0]["betas"][0] == 0.8
+    # peak of the cycle at step 10
+    s.step(10)
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.3, rtol=1e-6)
+    # momentum cycles inversely: at lr peak, momentum trough
+    np.testing.assert_allclose(opt.param_groups[0]["betas"][0], 0.8, rtol=1e-6)
+    # halfway up
+    s.step(5)
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.2, rtol=1e-6)
+    np.testing.assert_allclose(opt.param_groups[0]["betas"][0], 0.85, rtol=1e-6)
+    # end of down phase
+    s.step(20)
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.1, rtol=1e-6)
+
+
+def test_one_cycle_decay_phase():
+    opt = Shim()
+    s = L.OneCycle(opt, cycle_min_lr=0.1, cycle_max_lr=0.3,
+                   cycle_first_step_size=5, decay_step_size=5,
+                   decay_lr_rate=-0.1, cycle_momentum=False)
+    s.step(15)  # 5 past cycle end (total 10): decay_interval=1
+    np.testing.assert_allclose(opt.param_groups[0]["lr"], 0.1 * (1 - 0.1),
+                               rtol=1e-6)
+
+
+def test_state_dict_roundtrip():
+    for make in (
+        lambda o: L.WarmupLR(o, warmup_max_lr=0.1, warmup_num_steps=10),
+        lambda o: L.LRRangeTest(o, lr_range_test_min_lr=0.01),
+        lambda o: L.OneCycle(o, cycle_min_lr=0.1, cycle_max_lr=0.2),
+    ):
+        o1, o2 = Shim(), Shim()
+        s1 = make(o1)
+        for _ in range(7):
+            s1.step()
+        s2 = make(o2)
+        s2.load_state_dict(s1.state_dict())
+        s2.step()
+        s1.step()
+        assert o1.param_groups[0]["lr"] == o2.param_groups[0]["lr"]
+
+
+def test_multiple_groups_and_list_params():
+    opt = Shim(groups=2)
+    s = L.WarmupLR(opt, warmup_min_lr=[0.0, 0.001],
+                   warmup_max_lr=[0.01, 0.002], warmup_num_steps=10)
+    s.step(10)
+    assert opt.param_groups[0]["lr"] == 0.01
+    assert opt.param_groups[1]["lr"] == 0.002
+    with pytest.raises(ValueError):
+        L.WarmupLR(Shim(groups=2), warmup_min_lr=[0.0] * 3)
+
+
+def test_get_config_from_args_and_lr():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser = L.add_tuning_arguments(parser)
+    args = parser.parse_args(["--lr_schedule", "WarmupLR",
+                              "--warmup_max_lr", "0.005"])
+    cfg, err = L.get_config_from_args(args)
+    assert err is None
+    assert cfg["type"] == "WarmupLR"
+    assert cfg["params"]["warmup_max_lr"] == 0.005
+    lr, err = L.get_lr_from_config(cfg)
+    assert lr == 0.005 and err == ""
+    # unknown schedule
+    args = parser.parse_args([])
+    cfg, err = L.get_config_from_args(args)
+    assert cfg is None and "not specified" in err

@@ -1,0 +1,159 @@
+"""Optimizer numerics vs closed-form references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import ops
+
+
+def np_adam_reference(p, g, m, v, t, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                      scale=1.0, wd=0.0):
+    """Mirror of the fused kernel math (apex-style step-size bias
+    correction)."""
+    sg = g / scale
+    m = b1 * m + (1 - b1) * sg
+    v = b2 * v + (1 - b2) * sg * sg
+    step_size = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    upd = m / (np.sqrt(v) + eps) + wd * p
+    return p - step_size * upd, m, v
+
+
+def test_adam_matches_closed_form():
+    rng = np.random.default_rng(0)
+    p0 = rng.normal(size=(5, 3)).astype(np.float32)
+    params = {"w": jnp.asarray(p0)}
+    opt = ops.Adam(lr=1e-2, weight_decay=0.01)
+    state = opt.init(params)
+
+    p_np, m_np, v_np = p0.copy(), np.zeros_like(p0), np.zeros_like(p0)
+    for t in range(1, 5):
+        g = rng.normal(size=p0.shape).astype(np.float32)
+        params, state = opt.update(params, {"w": jnp.asarray(g)}, state)
+        p_np, m_np, v_np = np_adam_reference(p_np, g, m_np, v_np, t,
+                                             lr=1e-2, wd=0.01)
+        np.testing.assert_allclose(np.asarray(params["w"]), p_np, rtol=2e-5,
+                                   atol=1e-7)
+        assert int(state.step) == t
+
+
+def test_adam_combined_scale_divides_grads():
+    params = {"w": jnp.ones((4,))}
+    opt = ops.Adam(lr=1e-2)
+    s = opt.init(params)
+    p1, _ = opt.update(params, {"w": jnp.full((4,), 8.0)}, s, combined_scale=8.0)
+    p2, _ = opt.update(params, {"w": jnp.full((4,), 1.0)}, s, combined_scale=1.0)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    params = {"w": jnp.full((4,), 2.0)}
+    g = {"w": jnp.zeros((4,))}
+    aw = ops.AdamW(lr=0.1, weight_decay=0.1)
+    s = aw.init(params)
+    p, _ = aw.update(params, g, s)
+    # zero grads: update term 0, only decoupled decay applies: p - lr*wd*p
+    np.testing.assert_allclose(np.asarray(p["w"]), 2.0 - 0.1 * 0.1 * 2.0,
+                               rtol=1e-6)
+
+
+def test_lamb_trust_ratio_clamped():
+    # ||w|| huge, ||update|| tiny -> ratio clamps at max_coeff
+    params = {"w": jnp.full((16,), 100.0)}
+    g = {"w": jnp.full((16,), 1e-6)}
+    lamb = ops.Lamb(lr=1.0, max_coeff=10.0, min_coeff=0.01,
+                    bias_correction=False)
+    s = lamb.init(params)
+    p, _ = lamb.update(params, g, s)
+    # m = 0.1*g_scaled tiny; denom ~ sqrt(v)+eps; update magnitude bounded;
+    # delta = lr * coeff * upd with coeff == 10
+    delta = 100.0 - np.asarray(p["w"])[0]
+    # compute expected update leafwise
+    sg = 1e-6
+    m = 0.1 * sg
+    v = 0.001 * sg * sg
+    upd = m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(delta, 10.0 * upd, rtol=1e-4)
+
+
+def test_lamb_zero_norm_coeff_is_one():
+    # zero params -> ||w||=0 -> coeff 1.0 (kernel part3: only scale when both
+    # norms nonzero)
+    params = {"w": jnp.zeros((8,))}
+    g = {"w": jnp.ones((8,))}
+    lamb = ops.Lamb(lr=0.1, bias_correction=False)
+    s = lamb.init(params)
+    p, _ = lamb.update(params, g, s)
+    sg = 1.0
+    m = 0.1 * sg
+    v = 0.001
+    upd = m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(np.asarray(p["w"]), -0.1 * upd, rtol=1e-4)
+
+
+def test_lamb_per_tensor_ratio_differs():
+    # two leaves with very different scales get different trust ratios
+    params = {"a": jnp.full((4,), 100.0), "b": jnp.full((4,), 0.1)}
+    g = {"a": jnp.ones((4,)), "b": jnp.ones((4,))}
+    lamb = ops.Lamb(lr=0.01, bias_correction=False)
+    s = lamb.init(params)
+    p, _ = lamb.update(params, g, s)
+    da = 100.0 - float(p["a"][0])
+    db = 0.1 - float(p["b"][0])
+    assert da / db > 10  # big-norm tensor took a much larger step
+
+
+def test_sgd_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    p0 = rng.normal(size=(6,)).astype(np.float32)
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    topt = torch.optim.SGD([tp], lr=0.1, momentum=0.9)
+
+    params = {"w": jnp.asarray(p0)}
+    opt = ops.Sgd(lr=0.1, momentum=0.9)
+    s = opt.init(params)
+    for _ in range(3):
+        g = rng.normal(size=p0.shape).astype(np.float32)
+        tp.grad = torch.tensor(g)
+        topt.step()
+        params, s = opt.update(params, {"w": jnp.asarray(g)}, s)
+    np.testing.assert_allclose(np.asarray(params["w"]), tp.detach().numpy(),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_none_grads_leave_params_untouched():
+    # reference: p.grad None params are skipped (deepspeed_fused_lamb.py:151)
+    params = {"w": jnp.ones((4,)), "frozen": jnp.full((2,), 5.0)}
+    g = {"w": jnp.ones((4,)), "frozen": None}
+    for opt in (ops.Adam(lr=0.1), ops.Lamb(lr=0.1), ops.Sgd(lr=0.1)):
+        s = opt.init(params)
+        p, _ = opt.update(params, g, s)
+        np.testing.assert_array_equal(np.asarray(p["frozen"]),
+                                      np.full((2,), 5.0))
+        assert not np.array_equal(np.asarray(p["w"]), np.ones((4,)))
+
+
+def test_from_config():
+    o = ops.from_config("adam", {"lr": 0.1, "betas": [0.8, 0.88], "eps": 1e-6,
+                                 "weight_decay": 0.01, "max_grad_norm": 0.0})
+    assert isinstance(o, ops.Adam)
+    assert o.lr == 0.1 and o.beta1 == 0.8 and o.beta2 == 0.88
+    o = ops.from_config("lamb", {"lr": 0.004, "max_coeff": 0.5,
+                                 "min_coeff": 0.08})
+    assert isinstance(o, ops.Lamb)
+    assert o.max_coeff == 0.5 and o.min_coeff == 0.08
+    o = ops.from_config("sgd", {"lr": 0.1, "momentum": 0.9})
+    assert isinstance(o, ops.Sgd) and o.momentum == 0.9
+    with pytest.raises(ValueError):
+        ops.from_config("adagrad", {})
+
+
+def test_update_is_jittable():
+    opt = ops.Adam(lr=1e-3)
+    params = {"w": jnp.ones((8, 8))}
+    s = opt.init(params)
+    f = jax.jit(lambda p, g, s, lr: opt.update(p, g, s, lr=lr))
+    p, s2 = f(params, {"w": jnp.ones((8, 8))}, s, 1e-3)
+    assert int(s2.step) == 1
