@@ -1,0 +1,93 @@
+"""Headline benchmark: BERT-large pretrain throughput, samples/sec/chip.
+
+Reference number: 200 samples/s on one V100 at seq-len 128
+(/root/reference/docs/_tutorials/bert-pretraining.md:308-320); the driver's
+BASELINE.json tracks samples/sec/chip, so ``vs_baseline = value / 200``.
+
+Runs the real engine (bf16 + LAMB, the reference's BERT recipe) on however
+many chips are visible (one under the axon tunnel); reports per-chip
+throughput over steady-state steps after compile+warmup.
+
+Prints ONE json line: {"metric","value","unit","vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertForPreTraining
+    from deepspeed_tpu.parallel.topology import make_mesh
+
+    n_chips = jax.device_count()
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    # BERT-large on TPU; shrink via env for CPU smoke runs
+    size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "tiny")
+    batch_per_chip = int(os.environ.get(
+        "BENCH_BATCH", "256" if on_tpu else "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    model = BertForPreTraining.from_size(size, max_seq_len=max(seq, 128))
+    vocab = model.config.vocab_size
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={
+            "train_batch_size": batch_per_chip * n_chips,
+            "optimizer": {"type": "Lamb",
+                          "params": {"lr": 4e-3, "max_coeff": 0.5,
+                                     "min_coeff": 0.08}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10 ** 9,
+        },
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=make_mesh(model_parallel_size=1))
+
+    rng = np.random.default_rng(0)
+    B = batch_per_chip * n_chips
+    ids = rng.integers(0, vocab, size=(B, seq)).astype(np.int32)
+    mask = np.ones((B, seq), np.int32)
+    tt = np.zeros((B, seq), np.int32)
+    mlm = np.full((B, seq), -1, np.int32)
+    mlm[:, ::7] = ids[:, ::7]
+
+    def step():
+        loss = engine(ids, mask, tt, mlm)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    # compile + warmup
+    step()
+    step()
+    jax.block_until_ready(engine.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    jax.block_until_ready(engine.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = B * steps / dt
+    per_chip = samples_per_sec / n_chips
+    print(json.dumps({
+        "metric": "bert_%s_seq%d_pretrain_samples_per_sec_per_chip"
+                  % (size, seq),
+        "value": round(per_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / 200.0, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,6 +195,13 @@ class DeepSpeedTpuEngine:
 
         self.config = DeepSpeedConfig(cfg_src, dp_world_size=self.dp_world_size)
 
+        # model-side shape checks against the real mp degree (heads/vocab
+        # divisibility — the errors would otherwise surface as opaque reshape
+        # failures inside shard_map)
+        validate_fn = getattr(model, "validate", None)
+        if validate_fn is not None:
+            validate_fn(self.mp_world_size)
+
         # -- precision policy
         self.policy = prec.policy_from_config(self.config.fp16_enabled,
                                               self.config.bf16_enabled)

@@ -1,0 +1,19 @@
+"""Model family: tensor-parallel transformer building blocks + GPT-2 / BERT.
+
+The reference delegates models to Megatron-LM / BingBert examples; on TPU the
+framework owns a sharded model zoo (SURVEY.md §7.1 "mpu protocol" row).
+"""
+
+from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                              init_block_params,
+                                              block_partition_specs,
+                                              block_apply, stack_apply)
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
+from deepspeed_tpu.models.bert import (BertForPreTraining,
+                                       BertForQuestionAnswering, BERT_SIZES)
+
+__all__ = [
+    "TransformerConfig", "init_block_params", "block_partition_specs",
+    "block_apply", "stack_apply", "GPT2", "GPT2_SIZES",
+    "BertForPreTraining", "BertForQuestionAnswering", "BERT_SIZES",
+]

@@ -1,0 +1,208 @@
+"""BERT pretraining model (MLM + optional NSP) with tensor parallelism.
+
+Counterpart of the reference's BingBert pretraining + BingBertSquad fine-tune
+suites (/root/reference/tests/model/BingBertSquad/,
+docs/_tutorials/bert-pretraining.md — the 14h/64-GPU headline workload).
+Post-LN encoder per the original BERT; vocab-parallel MLM head tied to the
+embedding.  The SQuAD-style span head is provided for fine-tuning parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+
+BERT_SIZES = {
+    "tiny":  dict(num_layers=2,  hidden_size=128, num_heads=4,
+                  max_seq_len=128, vocab_size=512),
+    "base":  dict(num_layers=12, hidden_size=768, num_heads=12,
+                  vocab_size=30528, max_seq_len=512),
+    "large": dict(num_layers=24, hidden_size=1024, num_heads=16,
+                  vocab_size=30528, max_seq_len=512),
+}
+
+
+def _init_backbone_params(cfg: T.TransformerConfig, rng) -> dict:
+    """Embeddings (word/position/token-type) + encoder stack."""
+    cfg.validate()
+    h = cfg.hidden_size
+    ks = jax.random.split(rng, 4)
+    std = cfg.init_std
+    return {
+        "wte": jax.random.normal(ks[0], (cfg.vocab_size, h),
+                                 jnp.float32) * std,
+        "wpe": jax.random.normal(ks[1], (cfg.max_seq_len, h),
+                                 jnp.float32) * std,
+        "wtt": jax.random.normal(ks[2], (2, h), jnp.float32) * std,
+        "ln_emb_s": jnp.ones((h,), jnp.float32),
+        "ln_emb_b": jnp.zeros((h,), jnp.float32),
+        "blocks": T.init_block_params(cfg, ks[3]),
+    }
+
+
+def _backbone_partition_specs() -> dict:
+    return {
+        "wte": P(MODEL_AXIS, None),
+        "wpe": P(), "wtt": P(),
+        "ln_emb_s": P(), "ln_emb_b": P(),
+        "blocks": T.block_partition_specs(),
+    }
+
+
+def _encode(cfg, params, input_ids, attention_mask, token_type_ids):
+    """Embed + encoder stack (runs inside shard_map on local shards)."""
+    T_len = input_ids.shape[1]
+    x = L.vocab_parallel_embedding(input_ids, params["wte"])
+    x = x + params["wpe"][:T_len].astype(x.dtype)[None]
+    x = x + jnp.take(params["wtt"].astype(x.dtype), token_type_ids, axis=0)
+    x = L.layer_norm(x, params["ln_emb_s"], params["ln_emb_b"], cfg.ln_eps)
+    return T.stack_apply(x, params["blocks"], cfg, attn_mask=attention_mask)
+
+
+@dataclasses.dataclass
+class BertForPreTraining:
+    """MLM (+NSP when ``use_nsp``) pretraining loss.
+
+    apply(params, input_ids, attention_mask, token_type_ids, mlm_labels
+          [, nsp_labels]) → scalar loss.  mlm_labels < 0 are ignored.
+    """
+    config: T.TransformerConfig
+    use_nsp: bool = False
+
+    @classmethod
+    def from_size(cls, size: str, use_nsp: bool = False, **overrides):
+        kw = dict(BERT_SIZES[size])
+        kw.update(overrides)
+        kw.setdefault("pre_ln", False)   # BERT is post-LN
+        kw.setdefault("causal", False)
+        return cls(T.TransformerConfig(**kw), use_nsp=use_nsp)
+
+    def validate(self, mp_size: int = 1):
+        """Engine hook: shape checks against the actual mp degree."""
+        self.config.validate(mp_size)
+
+    def init_params(self, rng):
+        cfg = self.config
+        h = cfg.hidden_size
+        k_bb, k4, k5 = jax.random.split(rng, 3)
+        std = cfg.init_std
+        params = _init_backbone_params(cfg, k_bb)
+        params.update({
+            # MLM head: dense + LN + tied decoder with its own output bias
+            "mlm_dense_w": jax.random.normal(k4, (h, h), jnp.float32) * std,
+            "mlm_dense_b": jnp.zeros((h,), jnp.float32),
+            "mlm_ln_s": jnp.ones((h,), jnp.float32),
+            "mlm_ln_b": jnp.zeros((h,), jnp.float32),
+            "mlm_bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+        })
+        if self.use_nsp:
+            params["pool_w"] = jax.random.normal(k5, (h, h),
+                                                 jnp.float32) * std
+            params["pool_b"] = jnp.zeros((h,), jnp.float32)
+            params["nsp_w"] = jnp.zeros((h, 2), jnp.float32)
+            params["nsp_b"] = jnp.zeros((2,), jnp.float32)
+        return params
+
+    def partition_specs(self, params=None):
+        specs = _backbone_partition_specs()
+        specs.update({
+            "mlm_dense_w": P(), "mlm_dense_b": P(),
+            "mlm_ln_s": P(), "mlm_ln_b": P(),
+            "mlm_bias": P(MODEL_AXIS),     # rides with the vocab shard
+        })
+        if self.use_nsp:
+            specs.update({"pool_w": P(), "pool_b": P(),
+                          "nsp_w": P(), "nsp_b": P()})
+        return specs
+
+    def apply(self, params, input_ids, attention_mask, token_type_ids,
+              mlm_labels, nsp_labels=None):
+        cfg = self.config
+        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
+        # MLM head
+        g = L.gelu(x @ params["mlm_dense_w"].astype(x.dtype)
+                   + params["mlm_dense_b"].astype(x.dtype))
+        g = L.layer_norm(g, params["mlm_ln_s"], params["mlm_ln_b"], cfg.ln_eps)
+        logits = L.vocab_parallel_logits(g, params["wte"])
+        logits = logits + params["mlm_bias"].astype(logits.dtype)
+        tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_labels)
+        mask = (mlm_labels >= 0).astype(jnp.float32)
+        loss = jnp.sum(tok_loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        if self.use_nsp and nsp_labels is not None:
+            pooled = jnp.tanh(x[:, 0] @ params["pool_w"].astype(x.dtype)
+                              + params["pool_b"].astype(x.dtype))
+            nsp_logits = (pooled @ params["nsp_w"].astype(pooled.dtype)
+                          + params["nsp_b"].astype(pooled.dtype))
+            logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
+            nsp = -jnp.mean(jnp.take_along_axis(
+                logp, nsp_labels[:, None], axis=-1)[:, 0])
+            loss = loss + nsp
+        return loss
+
+    __call__ = apply
+
+
+@dataclasses.dataclass
+class BertForQuestionAnswering:
+    """SQuAD span-extraction fine-tune head (BingBertSquad parity,
+    /root/reference/tests/model/BingBertSquad/BingBertSquad_run_func_test.py).
+
+    apply(params, input_ids, attention_mask, token_type_ids, start_positions,
+    end_positions) → scalar loss.
+    """
+    config: T.TransformerConfig
+
+    @classmethod
+    def from_size(cls, size: str, **overrides):
+        kw = dict(BERT_SIZES[size])
+        kw.update(overrides)
+        kw.setdefault("pre_ln", False)
+        kw.setdefault("causal", False)
+        return cls(T.TransformerConfig(**kw))
+
+    def validate(self, mp_size: int = 1):
+        """Engine hook: shape checks against the actual mp degree."""
+        self.config.validate(mp_size)
+
+    def init_params(self, rng):
+        cfg = self.config
+        h = cfg.hidden_size
+        k_bb, k_qa = jax.random.split(rng, 2)
+        params = _init_backbone_params(cfg, k_bb)
+        params["qa_w"] = jax.random.normal(k_qa, (h, 2),
+                                           jnp.float32) * cfg.init_std
+        params["qa_b"] = jnp.zeros((2,), jnp.float32)
+        return params
+
+    def partition_specs(self, params=None):
+        specs = _backbone_partition_specs()
+        specs.update({"qa_w": P(), "qa_b": P()})
+        return specs
+
+    def apply(self, params, input_ids, attention_mask, token_type_ids,
+              start_positions, end_positions):
+        cfg = self.config
+        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
+        logits = (x @ params["qa_w"].astype(x.dtype)
+                  + params["qa_b"].astype(x.dtype)).astype(jnp.float32)
+        start_logits, end_logits = logits[..., 0], logits[..., 1]
+
+        def span_loss(lg, pos):
+            lg = jnp.where(attention_mask.astype(jnp.bool_), lg, -1e9)
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(
+                logp, pos[:, None], axis=-1)[:, 0])
+
+        return 0.5 * (span_loss(start_logits, start_positions)
+                      + span_loss(end_logits, end_positions))
+
+    __call__ = apply

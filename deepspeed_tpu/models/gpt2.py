@@ -1,0 +1,101 @@
+"""GPT-2 causal LM with Megatron-style tensor parallelism.
+
+The integration model for the engine — the reference's equivalent role is
+Megatron-LM GPT-2 driven through the mpu bridge
+(/root/reference/tests/model/Megatron_GPT2/ds_gpt2_test.sh:63-97,
+run_perf_test.py:18-62 for the 1.5B/4B/8B/20B configs).  Weight-tied
+vocab-parallel LM head feeds the vocab-parallel cross-entropy directly, so the
+full-vocab logits are never materialised on one shard.
+
+Engine protocol: ``init_params(rng)`` → global param pytree;
+``partition_specs(params)`` → PartitionSpec tree; ``apply(params, tokens,
+labels)`` → scalar mean loss (runs inside shard_map; see models/layers.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+
+# Published GPT-2 size ladder incl. the reference's perf-test configs
+# (/root/reference/tests/model/Megatron_GPT2/run_perf_test.py:18-62).
+GPT2_SIZES = {
+    "tiny":   dict(num_layers=2,  hidden_size=128,  num_heads=4,
+                   max_seq_len=128, vocab_size=512),
+    "small":  dict(num_layers=12, hidden_size=768,  num_heads=12),
+    "medium": dict(num_layers=24, hidden_size=1024, num_heads=16),
+    "large":  dict(num_layers=24, hidden_size=1536, num_heads=16),
+    "xl-1.5b": dict(num_layers=48, hidden_size=1600, num_heads=25),
+    "4b":     dict(num_layers=64, hidden_size=2304, num_heads=24),
+    "8b":     dict(num_layers=72, hidden_size=3072, num_heads=24),
+    "20b":    dict(num_layers=111, hidden_size=3808, num_heads=32),
+}
+
+
+@dataclasses.dataclass
+class GPT2:
+    """Callable model object satisfying the engine protocol."""
+    config: T.TransformerConfig
+
+    @classmethod
+    def from_size(cls, size: str, **overrides) -> "GPT2":
+        kw = dict(GPT2_SIZES[size])
+        kw.update(overrides)
+        kw.setdefault("pre_ln", True)
+        kw.setdefault("causal", True)
+        return cls(T.TransformerConfig(**kw))
+
+    def validate(self, mp_size: int = 1):
+        """Engine hook: shape checks against the actual mp degree."""
+        self.config.validate(mp_size)
+
+    # ------------------------------------------------------------------ init
+    def init_params(self, rng):
+        cfg = self.config
+        cfg.validate()
+        k_wte, k_wpe, k_blocks = jax.random.split(rng, 3)
+        return {
+            "wte": jax.random.normal(
+                k_wte, (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+            * cfg.init_std,
+            "wpe": jax.random.normal(
+                k_wpe, (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+            * cfg.init_std * 0.5,
+            "blocks": T.init_block_params(cfg, k_blocks),
+            "lnf_s": jnp.ones((cfg.hidden_size,), jnp.float32),
+            "lnf_b": jnp.zeros((cfg.hidden_size,), jnp.float32),
+        }
+
+    def partition_specs(self, params=None):
+        return {
+            "wte": P(MODEL_AXIS, None),   # vocab-parallel
+            "wpe": P(),
+            "blocks": T.block_partition_specs(),
+            "lnf_s": P(), "lnf_b": P(),
+        }
+
+    # --------------------------------------------------------------- forward
+    def apply(self, params, tokens, labels):
+        """tokens, labels: int32 [B, T]; labels < 0 are ignored.  Returns the
+        mean per-token LM loss (fp32 scalar, local to the DP shard — the
+        engine pmean's across data)."""
+        cfg = self.config
+        T_len = tokens.shape[1]
+        x = L.vocab_parallel_embedding(tokens, params["wte"])
+        x = x + params["wpe"][:T_len].astype(x.dtype)[None]
+        x = T.stack_apply(x, params["blocks"], cfg)
+        x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
+        logits = L.vocab_parallel_logits(x, params["wte"])
+        loss = L.vocab_parallel_cross_entropy(logits, labels)
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    __call__ = apply

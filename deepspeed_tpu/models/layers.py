@@ -1,0 +1,152 @@
+"""Tensor-parallel layer primitives (Megatron-style) for the ('data','model')
+mesh.
+
+The reference consumes an external Megatron-LM for tensor parallelism through
+the ``mpu`` protocol (/root/reference/docs/_pages/features.md §"Support for
+Custom Model Parallelism"; engine hooks at
+/root/reference/deepspeed/pt/deepspeed_light.py:420-430).  On TPU we own the
+model layer, so the Megatron column/row-parallel linears, vocab-parallel
+embedding and vocab-parallel cross-entropy are provided here as pure functions
+meant to run INSIDE ``shard_map``: every function sees *local* shards of its
+weights and issues explicit collectives (``psum``/``pmax``) over the ``model``
+mesh axis.  With ``model`` axis size 1 every collective degenerates to a
+no-op, so the same model code serves mp=1 and mp>1.
+
+Conventions:
+* column-parallel weight  [in, out/mp]  — output stays sharded, no collective
+  in forward (Megatron's "f" operator: JAX autodiff inserts the backward
+  all-reduce for the replicated input automatically through shard_map).
+* row-parallel weight     [in/mp, out]  — forward ends with a psum over
+  ``model`` (Megatron's "g" operator); bias is replicated and added after.
+* QKV packing is head-major ``(n_heads, 3, head_dim)`` flattened on the output
+  dim, so an even split over ``model`` hands each shard whole heads with their
+  q, k and v together.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+
+def column_parallel_linear(x, w_local, b_local=None):
+    """x: [..., in] replicated over model axis; w_local: [in, out/mp].
+    Returns [..., out/mp] (sharded on the feature dim)."""
+    y = x @ w_local.astype(x.dtype)
+    if b_local is not None:
+        y = y + b_local.astype(y.dtype)
+    return y
+
+
+def row_parallel_linear(x_local, w_local, b=None, axis=MODEL_AXIS):
+    """x_local: [..., in/mp]; w_local: [in/mp, out].  psum completes the
+    contraction over the sharded input dim; result is replicated."""
+    y = jax.lax.psum(x_local @ w_local.astype(x_local.dtype), axis)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def vocab_parallel_embedding(tokens, wte_local, axis=MODEL_AXIS):
+    """tokens: int [...]; wte_local: [vocab/mp, h] (vocab dim sharded).
+
+    Masked local lookup + psum (Megatron VocabParallelEmbedding): each shard
+    contributes rows it owns, zeros elsewhere.
+    """
+    vocab_local = wte_local.shape[0]
+    start = jax.lax.axis_index(axis) * vocab_local
+    idx = tokens - start
+    valid = (idx >= 0) & (idx < vocab_local)
+    idx = jnp.clip(idx, 0, vocab_local - 1)
+    emb = jnp.take(wte_local, idx, axis=0)
+    emb = emb * valid[..., None].astype(emb.dtype)
+    return jax.lax.psum(emb, axis)
+
+
+def vocab_parallel_logits(h, wte_local):
+    """Weight-tied LM head: h [..., hid] replicated; wte_local [vocab/mp, hid]
+    → logits [..., vocab/mp] sharded on the vocab dim (feeds directly into
+    ``vocab_parallel_cross_entropy`` with no gather)."""
+    return h @ wte_local.astype(h.dtype).T
+
+
+def vocab_parallel_cross_entropy(logits_local, labels, axis=MODEL_AXIS):
+    """Per-token CE over vocab-sharded logits (Megatron's vocab-parallel
+    softmax-CE: pmax for the max, psum for the partition function and the
+    target logit — never materialises the full-vocab softmax on one shard).
+
+    logits_local: [..., vocab/mp] (any float dtype; math in fp32)
+    labels:       int [...]
+    returns       fp32 [...] per-token loss
+    """
+    logits_local = logits_local.astype(jnp.float32)
+    vocab_local = logits_local.shape[-1]
+    start = jax.lax.axis_index(axis) * vocab_local
+
+    # the max shift is numerical stabilisation only — stop-grad before the
+    # pmax (which has no differentiation rule); CE grads flow via shifted/tgt
+    lmax = jax.lax.pmax(
+        jnp.max(jax.lax.stop_gradient(logits_local), axis=-1), axis)
+    shifted = logits_local - lmax[..., None]
+    sumexp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis)
+
+    idx = labels - start
+    valid = (idx >= 0) & (idx < vocab_local)
+    idxc = jnp.clip(idx, 0, vocab_local - 1)
+    tgt_local = jnp.take_along_axis(shifted, idxc[..., None], axis=-1)[..., 0]
+    tgt = jax.lax.psum(tgt_local * valid.astype(jnp.float32), axis)
+
+    return jnp.log(sumexp) - tgt
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    """LayerNorm in fp32 (bf16/fp16 inputs upcast for the moments)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def gelu(x):
+    """tanh-approx GELU (matches GPT-2/BERT)."""
+    xf = x.astype(jnp.float32)
+    y = 0.5 * xf * (1.0 + jnp.tanh(
+        0.7978845608028654 * (xf + 0.044715 * xf ** 3)))
+    return y.astype(x.dtype)
+
+
+def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
+                        *, n_heads_global, causal, attn_mask=None,
+                        axis=MODEL_AXIS):
+    """Tensor-parallel multi-head attention over local heads.
+
+    x:            [B, T, h] replicated over ``model``
+    qkv_w_local:  [h, 3h/mp]  packed head-major (n_local, 3, d)
+    qkv_b_local:  [3h/mp]
+    proj_w_local: [h/mp, h]   row-parallel output projection
+    proj_b:       [h]         replicated
+    attn_mask:    optional [B, T] with 1=attend, 0=pad (BERT)
+    """
+    B, T, h = x.shape
+    d = h // n_heads_global
+    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)  # [B,T,3h/mp]
+    n_local = qkv.shape[-1] // (3 * d)
+    qkv = qkv.reshape(B, T, n_local, 3, d)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]   # [B,T,n,d]
+
+    scores = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        cmask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        scores = jnp.where(cmask[None, None], scores, -1e9)
+    if attn_mask is not None:
+        scores = jnp.where(attn_mask[:, None, None, :].astype(jnp.bool_),
+                           scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bnts,bsnd->btnd", probs, v)               # [B,T,n,d]
+    ctx = ctx.reshape(B, T, n_local * d)                        # [B,T,h/mp]
+    return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)

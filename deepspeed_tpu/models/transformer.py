@@ -1,0 +1,123 @@
+"""Shared tensor-parallel transformer stack (GPT-2 and BERT build on this).
+
+The reference proves its engine against Megatron-LM GPT-2 and BingBert
+(/root/reference/tests/model/Megatron_GPT2/ds_gpt2_test.sh,
+tests/model/BingBertSquad/) but outsources the model code.  On TPU we own the
+model: blocks are written against the local-shard view used inside
+``shard_map`` (see models/layers.py), layers are STACKED on a leading axis and
+iterated with ``lax.scan`` so XLA compiles one block body regardless of depth,
+and per-block rematerialisation (``jax.checkpoint``) stands in for Megatron's
+``--checkpoint-activations``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304
+    max_seq_len: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    pre_ln: bool = True           # GPT-2 pre-LN; BERT uses post-LN
+    causal: bool = True
+    remat: bool = True            # per-block activation checkpointing
+    init_std: float = 0.02
+    ln_eps: float = 1e-5
+
+    def validate(self, mp_size: int = 1):
+        h, n = self.hidden_size, self.num_heads
+        if h % n:
+            raise ValueError(f"hidden {h} not divisible by heads {n}")
+        if n % mp_size:
+            raise ValueError(f"heads {n} not divisible by mp {mp_size}")
+        if self.vocab_size % mp_size:
+            raise ValueError(
+                f"vocab {self.vocab_size} not divisible by mp {mp_size}")
+
+
+def init_block_params(cfg: TransformerConfig, rng) -> dict:
+    """Stacked [L, ...] block parameters, GPT-2 style init (normal 0.02;
+    residual projections scaled by 1/sqrt(2L))."""
+    Lyr, h = cfg.num_layers, cfg.hidden_size
+    ff = cfg.mlp_ratio * h
+    ks = jax.random.split(rng, 4)
+    std = cfg.init_std
+    resid_std = std / jnp.sqrt(2.0 * Lyr)
+    norm = lambda k, shape, s: (jax.random.normal(k, shape, jnp.float32) * s)
+    return {
+        "ln1_s": jnp.ones((Lyr, h), jnp.float32),
+        "ln1_b": jnp.zeros((Lyr, h), jnp.float32),
+        # packed head-major (n, 3, d) on the out dim — see layers.py
+        "qkv_w": norm(ks[0], (Lyr, h, 3 * h), std),
+        "qkv_b": jnp.zeros((Lyr, 3 * h), jnp.float32),
+        "proj_w": norm(ks[1], (Lyr, h, h), resid_std),
+        "proj_b": jnp.zeros((Lyr, h), jnp.float32),
+        "ln2_s": jnp.ones((Lyr, h), jnp.float32),
+        "ln2_b": jnp.zeros((Lyr, h), jnp.float32),
+        "fc_w": norm(ks[2], (Lyr, h, ff), std),
+        "fc_b": jnp.zeros((Lyr, ff), jnp.float32),
+        "fc2_w": norm(ks[3], (Lyr, ff, h), resid_std),
+        "fc2_b": jnp.zeros((Lyr, h), jnp.float32),
+    }
+
+
+def block_partition_specs() -> dict:
+    """Megatron sharding: QKV + MLP-in column-parallel (out dim over
+    ``model``), attention-out + MLP-out row-parallel (in dim over ``model``);
+    LayerNorms and row-parallel biases replicated.  Leading axis = layer
+    stack."""
+    return {
+        "ln1_s": P(), "ln1_b": P(),
+        "qkv_w": P(None, None, MODEL_AXIS), "qkv_b": P(None, MODEL_AXIS),
+        "proj_w": P(None, MODEL_AXIS, None), "proj_b": P(),
+        "ln2_s": P(), "ln2_b": P(),
+        "fc_w": P(None, None, MODEL_AXIS), "fc_b": P(None, MODEL_AXIS),
+        "fc2_w": P(None, MODEL_AXIS, None), "fc2_b": P(),
+    }
+
+
+def _mlp(x, p):
+    y = L.column_parallel_linear(x, p["fc_w"], p["fc_b"])
+    y = L.gelu(y)
+    return L.row_parallel_linear(y, p["fc2_w"], p["fc2_b"])
+
+
+def block_apply(x, p, cfg: TransformerConfig, attn_mask=None):
+    """One transformer block on local shards.  p leaves have NO leading layer
+    axis here (scan slices it off)."""
+    attn = lambda u: L.multihead_attention(
+        u, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"],
+        n_heads_global=cfg.num_heads, causal=cfg.causal,
+        attn_mask=attn_mask)
+    ln1 = lambda u: L.layer_norm(u, p["ln1_s"], p["ln1_b"], cfg.ln_eps)
+    ln2 = lambda u: L.layer_norm(u, p["ln2_s"], p["ln2_b"], cfg.ln_eps)
+    if cfg.pre_ln:
+        x = x + attn(ln1(x))
+        x = x + _mlp(ln2(x), p)
+    else:  # post-LN (BERT)
+        x = ln1(x + attn(x))
+        x = ln2(x + _mlp(x, p))
+    return x
+
+
+def stack_apply(x, stacked_params, cfg: TransformerConfig, attn_mask=None):
+    """Run all layers via lax.scan over the stacked [L, ...] params."""
+    def body(carry, lp):
+        return block_apply(carry, lp, cfg, attn_mask), None
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, stacked_params)
+    return x

@@ -1,0 +1,185 @@
+"""Model-family tests: tensor-parallel correctness on the 8-fake-device mesh.
+
+The reference's equivalent tier runs Megatron-GPT2 with mp ∈ {1,2,4} and
+asserts loss parity (/root/reference/tests/model/Megatron_GPT2/
+run_func_test.py:46-122).  Here the TP model is in-repo, so the parity matrix
+runs as a unit test: identical data + init must give identical losses at every
+mp degree (fp32, tolerance ~1e-4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models import (GPT2, BertForPreTraining,
+                                  BertForQuestionAnswering)
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.parallel.topology import make_mesh
+
+VOCAB, SEQ = 64, 16
+
+
+def tiny_gpt2(**over):
+    return GPT2.from_size("tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                          num_layers=2, hidden_size=32, num_heads=4, **over)
+
+
+def lm_batch(batch_size, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, VOCAB, size=(batch_size, SEQ)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+    return toks, labels
+
+
+def gpt2_config(mp, batch=8, **over):
+    cfg = {
+        "train_batch_size": batch,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "model_parallel_size": mp,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_gpt2(mp, steps=3, **cfg_over):
+    model = tiny_gpt2()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=gpt2_config(mp, **cfg_over), model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(7)),
+        mesh=make_mesh(model_parallel_size=mp))
+    losses = []
+    for i in range(steps):
+        toks, labels = lm_batch(8, seed=i)
+        loss = engine(toks, labels)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_gpt2_tp_parity_mp124():
+    """Same data+init ⇒ same loss trajectory for mp=1,2,4 (fp32)."""
+    ref = run_gpt2(1)
+    for mp in (2, 4):
+        got = run_gpt2(mp)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt2_loss_decreases_bf16():
+    losses = run_gpt2(2, steps=10, bf16={"enabled": True})
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_fp16_with_loss_scale():
+    losses = run_gpt2(2, steps=5,
+                      fp16={"enabled": True, "initial_scale_power": 8})
+    assert all(np.isfinite(losses))
+
+
+def test_vocab_parallel_cross_entropy_matches_dense():
+    """TP softmax-CE vs plain log_softmax on a 4-way model mesh."""
+    mesh = make_mesh(model_parallel_size=4)
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(4, 8, VOCAB)).astype(np.float32)
+    labels = rng.integers(0, VOCAB, size=(4, 8)).astype(np.int32)
+
+    def local(lg, lb):
+        return L.vocab_parallel_cross_entropy(lg, lb)
+
+    fn = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data", None, "model"), P("data", None)),
+        out_specs=P("data", None), check_vma=False))
+    got = np.asarray(fn(logits, labels))
+
+    logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    want = -np.take_along_axis(np.asarray(logp), labels[..., None],
+                               axis=-1)[..., 0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_vocab_parallel_embedding_matches_dense():
+    mesh = make_mesh(model_parallel_size=4)
+    rng = np.random.default_rng(1)
+    wte = rng.normal(size=(VOCAB, 8)).astype(np.float32)
+    toks = rng.integers(0, VOCAB, size=(8, 5)).astype(np.int32)
+
+    fn = jax.jit(jax.shard_map(
+        lambda t, w: L.vocab_parallel_embedding(t, w),
+        mesh=mesh, in_specs=(P("data", None), P("model", None)),
+        out_specs=P("data", None, None), check_vma=False))
+    got = np.asarray(fn(toks, wte))
+    np.testing.assert_allclose(got, wte[toks], rtol=1e-6, atol=1e-6)
+
+
+def bert_batch(batch_size, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, VOCAB, size=(batch_size, SEQ)).astype(np.int32)
+    mask = np.ones((batch_size, SEQ), np.int32)
+    mask[:, SEQ - 4:] = 0                      # padded tail
+    tt = np.zeros((batch_size, SEQ), np.int32)
+    tt[:, SEQ // 2:] = 1
+    mlm = np.full((batch_size, SEQ), -1, np.int32)
+    mlm[:, ::5] = ids[:, ::5]                  # predict every 5th token
+    return ids, mask, tt, mlm
+
+
+def test_bert_mlm_training():
+    model = BertForPreTraining.from_size(
+        "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+        num_layers=2, hidden_size=32, num_heads=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=gpt2_config(2), model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(3)),
+        mesh=make_mesh(model_parallel_size=2))
+    losses = []
+    for i in range(8):
+        batch = bert_batch(8, seed=i % 2)
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_nsp_head():
+    model = BertForPreTraining.from_size(
+        "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+        num_layers=2, hidden_size=32, num_heads=4, use_nsp=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=gpt2_config(1), model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(3)))
+    ids, mask, tt, mlm = bert_batch(8)
+    nsp = np.asarray([0, 1] * 4, np.int32)
+    loss = engine(ids, mask, tt, mlm, nsp)
+    engine.backward(loss)
+    engine.step()
+    assert np.isfinite(float(loss))
+
+
+def test_bert_squad_head():
+    model = BertForQuestionAnswering.from_size(
+        "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+        num_layers=2, hidden_size=32, num_heads=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=gpt2_config(2), model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(5)),
+        mesh=make_mesh(model_parallel_size=2))
+    ids, mask, tt, _ = bert_batch(8)
+    rng = np.random.default_rng(0)
+    start = rng.integers(0, SEQ - 6, size=(8,)).astype(np.int32)
+    end = (start + 2).astype(np.int32)
+    losses = []
+    for _ in range(5):
+        loss = engine(ids, mask, tt, start, end)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
