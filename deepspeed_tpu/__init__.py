@@ -54,6 +54,19 @@ def initialize(args=None,
     return tuple(return_items)
 
 
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, use_mpi=False):
+    """Early multi-host rendezvous — MUST run before any other JAX call on
+    multi-host launches (jax.distributed requirement).  The engine also
+    triggers this from its ctor, but user scripts that touch JAX before
+    ``initialize()`` (e.g. to init model params) should call this first.
+    Reference analog: dist.init_process_group, deepspeed_light.py:125-130."""
+    from deepspeed_tpu.parallel.topology import init_distributed as _init
+    _init(coordinator_address=coordinator_address,
+          num_processes=num_processes, process_id=process_id,
+          use_mpi=use_mpi)
+
+
 def _add_core_arguments(parser):
     """Core flags (reference /root/reference/deepspeed/__init__.py:105-153)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -535,13 +535,23 @@ class DeepSpeedTpuEngine:
 
         def local(params, ls_scale, batch_args):
             def loss_fn(p):
-                loss = apply_fn(p, *batch_args)
+                out = apply_fn(p, *batch_args)
+                # multi-output models return a tuple of losses; grads are of
+                # the sum (the reference user sums before backward —
+                # tests/unit/test_multi_output_model.py), each loss is
+                # reported separately
+                if isinstance(out, (tuple, list)):
+                    total = sum(jnp.asarray(l, jnp.float32) for l in out)
+                else:
+                    total = jnp.asarray(out, jnp.float32)
                 # loss scaling + grad-accum prescale in one multiply
                 # (reference _scale_loss :583 + loss_scaler backward :176-178)
-                return jnp.asarray(loss, jnp.float32) * (ls_scale / gas)
-            scaled_loss, grads = jax.value_and_grad(loss_fn)(params)
-            raw_loss = scaled_loss * (gas / ls_scale)
-            loss_out = jax.lax.pmean(raw_loss, DATA_AXIS)
+                return total * (ls_scale / gas), out
+            (_, raw_out), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            loss_out = jax.tree_util.tree_map(
+                lambda l: jax.lax.pmean(jnp.asarray(l, jnp.float32),
+                                        DATA_AXIS), raw_out)
             grads = self._psum_model_replicated(grads)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32)[None], grads)
@@ -559,7 +569,9 @@ class DeepSpeedTpuEngine:
 
         def local(params, batch_args):
             out = apply_fn(params, *batch_args)
-            return jax.lax.pmean(jnp.asarray(out, jnp.float32), DATA_AXIS)
+            return jax.tree_util.tree_map(
+                lambda l: jax.lax.pmean(jnp.asarray(l, jnp.float32),
+                                        DATA_AXIS), out)
 
         fn = jax.shard_map(
             local, mesh=self.mesh,
@@ -619,9 +631,10 @@ class DeepSpeedTpuEngine:
             self.sample_count = (self.train_micro_batch_size_per_gpu()
                                  * self.dp_world_size * (self.micro_steps + 1))
             if self._last_loss is not None:
+                scalar = sum(float(l) for l in
+                             jax.tree_util.tree_leaves(self._last_loss))
                 self.summary_writer.add_scalar("Train/Samples/train_loss",
-                                               float(self._last_loss),
-                                               self.sample_count)
+                                               scalar, self.sample_count)
 
         if self._acc is None:
             self._acc = self._cached_grads
@@ -631,7 +644,12 @@ class DeepSpeedTpuEngine:
         self._cached_grads = None
         if wcb:
             self.timers(BACKWARD_TIMER).stop(sync_on=self._acc)
-        return loss
+        # the reference returns the grad-accum-scaled loss from backward
+        # (asserted by tests/unit/test_multi_output_model.py)
+        if loss is None:
+            return None
+        gas = float(self.gradient_accumulation_steps())
+        return jax.tree_util.tree_map(lambda l: l / gas, loss)
 
     # ------------------------------------------------------------------- step
 
@@ -808,6 +826,11 @@ class DeepSpeedTpuEngine:
             self.step()
             return loss
         # split the global batch into gas micro-batches host-side
+        lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if lead % gas != 0:
+            raise ValueError(
+                f"train_batch: leading batch dim {lead} is not divisible by "
+                f"gradient_accumulation_steps={gas}")
         losses = []
         for i in range(gas):
             micro = jax.tree_util.tree_map(
@@ -831,6 +854,25 @@ class DeepSpeedTpuEngine:
         if jax.process_index() == 0:
             logger.info("step=%d, skipped=%d, lr=%s, mom=%s",
                         step, self.skipped_steps, lr, mom)
+
+    # ---------------------------------------------------------- checkpointing
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        """reference deepspeed_light.py:1048-1114"""
+        from deepspeed_tpu import checkpoint as ckpt_mod
+        return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
+                                        client_state=client_state)
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        """reference deepspeed_light.py:974-1046; returns (path,
+        client_state)."""
+        from deepspeed_tpu import checkpoint as ckpt_mod
+        path, client = ckpt_mod.load_checkpoint(
+            self, load_dir, tag=tag,
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states)
+        return path, client
 
     # ------------------------------------------------- optimizer state (ckpt)
 

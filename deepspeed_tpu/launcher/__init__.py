@@ -1,0 +1,3 @@
+"""Multi-node launcher (``dst``): hostfile + include/exclude DSL + per-node
+process spawn.  Analog of /root/reference/deepspeed/pt/deepspeed_run.py and
+deepspeed_launch.py (shipped as bin/ds, bin/ds_ssh)."""

@@ -1,0 +1,95 @@
+"""Per-node launcher: decode world info, export the rendezvous contract,
+spawn the user script.
+
+Analog of /root/reference/deepspeed/pt/deepspeed_launch.py:56-119, with the
+process model changed for TPU: the reference spawns one subprocess per local
+GPU with ``--local_rank=i`` and CUDA_VISIBLE_DEVICES; a TPU host runs ONE
+process that drives all local chips, so the global rank mapping is
+slot-granular only for CPU/virtual fleets.  Env contract exported to the
+child (consumed by ``parallel.topology.init_distributed``):
+
+    DSTPU_COORDINATOR     = master_addr:master_port   (≈ MASTER_ADDR/PORT)
+    DSTPU_NUM_PROCESSES   = total process count       (≈ WORLD_SIZE)
+    DSTPU_PROCESS_ID      = this process's rank       (≈ RANK)
+
+``--local_rank`` is still appended to the child args for reference-CLI
+parity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.run import decode_world_info
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="per-node process launcher")
+    parser.add_argument("--node_rank", type=int, default=0,
+                        help="Rank of this node in the world info")
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 JSON of host → slot list")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def global_rank_mapping(world_info):
+    """host → list of global process ranks (reference
+    deepspeed_launch.py:81-91)."""
+    mapping = {}
+    rank = 0
+    for host, slots in world_info.items():
+        mapping[host] = list(range(rank, rank + len(slots)))
+        rank += len(slots)
+    return mapping
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    assert len(world_info) > 0, "empty world info"
+
+    hosts = list(world_info.keys())
+    node_host = hosts[args.node_rank]
+    mapping = global_rank_mapping(world_info)
+    local_ranks = mapping[node_host]
+    world_size = sum(len(v) for v in mapping.values())
+
+    procs = []
+    for local_rank, global_rank in enumerate(local_ranks):
+        env = os.environ.copy()
+        env["DSTPU_COORDINATOR"] = f"{args.master_addr}:{args.master_port}"
+        env["DSTPU_NUM_PROCESSES"] = str(world_size)
+        env["DSTPU_PROCESS_ID"] = str(global_rank)
+        # reference-compatible spellings
+        env["MASTER_ADDR"] = args.master_addr
+        env["MASTER_PORT"] = str(args.master_port)
+        env["WORLD_SIZE"] = str(world_size)
+        env["RANK"] = str(global_rank)
+        env["LOCAL_RANK"] = str(local_rank)
+        cmd = ([sys.executable, "-u", args.training_script]
+               + args.training_script_args
+               + [f"--local_rank={local_rank}"])
+        logger.info("node %s rank %d: %s", node_host, global_rank, cmd)
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(main())

@@ -139,7 +139,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if process_id is None:
         process_id = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
 
-    if num_processes <= 1 and coordinator_address is None:
+    if num_processes <= 1:
+        # nothing to rendezvous — also covers launcher-spawned 1-process runs
+        # that export DSTPU_COORDINATOR (calling jax.distributed.initialize
+        # here would fail if the XLA backend is already up)
         logger.info("init_distributed: single-process run, skipping rendezvous")
         return
 

@@ -1,0 +1,96 @@
+"""Row-sparse (CSR-style) gradient representation.
+
+Parity port of /root/reference/deepspeed/pt/deepspeed_csr_tensor.py
+(`CSRTensor`, à la TF IndexedSlices): nonzero-row ``indices`` + ``values``,
+``to_dense`` scatter-add, ``add`` by concatenation.  The reference engine
+routes ``nn.Embedding`` gradients through an allgather of (indices, values)
+instead of a dense allreduce (deepspeed_light.py:884-940) because embedding
+grads on commodity interconnects are bandwidth-bound and row-sparse.
+
+On TPU the calculus differs: ICI bandwidth is high enough that XLA's dense
+``psum`` of an embedding gradient is normally FASTER than gather+densify
+(and `scatter_add` generates serialized HBM traffic on the VPU), so the
+engine keeps embedding grads dense under jit and this module exists for API
+parity, host-side gradient inspection, and DCN-crossing edge cases.  The
+``sparse_gradients`` config flag is accepted (constants.py) and documented as
+a no-op optimization under SPMD; `csr_allreduce` implements the reference's
+gather-then-densify semantics for host-level use.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class CSRTensor:
+    """Row-sparse tensor: ``indices`` (nonzero row ids) + ``values`` (those
+    rows).  Reference: deepspeed_csr_tensor.py:11-59."""
+
+    def __init__(self, dense=None):
+        self.orig_dense_size = None
+        self.indices = None
+        self.values = None
+        if dense is not None:
+            dense = jnp.asarray(dense)
+            self.orig_dense_size = tuple(dense.shape)
+            row_nnz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+            idx = jnp.nonzero(row_nnz)[0]
+            self.indices = idx
+            self.values = dense[idx]
+
+    @classmethod
+    def type(cls):
+        return "deepspeed_tpu.sparse.CSRTensor"
+
+    @classmethod
+    def from_parts(cls, indices, values, dense_size) -> "CSRTensor":
+        t = cls()
+        t.indices = jnp.asarray(indices)
+        t.values = jnp.asarray(values)
+        t.orig_dense_size = tuple(dense_size)
+        return t
+
+    @property
+    def dense_size(self):
+        return self.orig_dense_size
+
+    def add(self, other: "CSRTensor") -> None:
+        """Sparse accumulate by concatenation (duplicate rows resolved by the
+        scatter-add in ``to_dense``).  Reference :45-57."""
+        assert self.orig_dense_size == other.orig_dense_size, (
+            "Cannot add tensors of different dense sizes")
+        self.indices = jnp.concatenate([self.indices, other.indices])
+        self.values = jnp.concatenate([self.values, other.values])
+
+    def scale(self, factor) -> "CSRTensor":
+        return CSRTensor.from_parts(self.indices, self.values * factor,
+                                    self.orig_dense_size)
+
+    def to_dense(self) -> jnp.ndarray:
+        """Scatter-add back to dense (reference :29-43)."""
+        out = jnp.zeros(self.orig_dense_size,
+                        self.values.dtype if self.values is not None
+                        else jnp.float32)
+        if self.indices is None or self.indices.size == 0:
+            return out
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        nnz = int(self.indices.size) * int(np.prod(self.values.shape[1:]))
+        return nnz, int(np.prod(self.orig_dense_size))
+
+
+def csr_allreduce(shards: List[CSRTensor],
+                  world_size: Optional[int] = None) -> jnp.ndarray:
+    """Reference csr_allreduce semantics (deepspeed_light.py:884-940): each
+    rank's (indices, values) are pre-divided by world size, all-gathered,
+    concatenated and densified.  Host-level helper: ``shards`` is the gathered
+    list; returns the averaged dense gradient."""
+    world = world_size if world_size is not None else len(shards)
+    total = shards[0].scale(1.0 / world)
+    for s in shards[1:]:
+        total.add(s.scale(1.0 / world))
+    return total.to_dense()

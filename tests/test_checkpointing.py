@@ -1,0 +1,194 @@
+"""Checkpoint round-trip tests.
+
+Port of /root/reference/tests/unit/test_checkpointing.py: train N steps →
+save → fresh engine → load → deep-compare compute-dtype weights, fp32
+masters, inner optimizer state tensors, loss-scale + scheduler state; then
+continue training both and compare losses (resume parity).  Run with and
+without optimizer-state load, with and without ZeRO, and ZeRO across a
+DIFFERENT dp world size (the re-partition path).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import make_mesh
+from simple_model import SimpleModel, random_dataset
+
+HIDDEN = 16
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 32,
+        "steps_per_print": 10 ** 6,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0.0,
+                                 "warmup_max_lr": 0.01,
+                                 "warmup_num_steps": 20}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def make_engine(config, seed=0, mesh=None):
+    model = SimpleModel(HIDDEN)
+    engine, optim, _, _ = deepspeed_tpu.initialize(
+        config=config, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(seed)),
+        mesh=mesh)
+    return engine, optim
+
+
+def train(engine, steps, data_seed=0):
+    ds = random_dataset(64, HIDDEN, seed=data_seed)
+    dl = engine.deepspeed_io(ds)
+    it = iter(dl)
+    losses = []
+    for _ in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(dl)
+            batch = next(it)
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def tree_equal(a, b, rtol=0.0, atol=0.0):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("zero", [False, True])
+def test_checkpoint_roundtrip_bit_exact(tmpdir, zero):
+    cfg = base_config(zero_optimization=zero)
+    e1, _ = make_engine(cfg)
+    train(e1, 12)
+    path = e1.save_checkpoint(str(tmpdir), client_state={"epoch": 3})
+    assert path
+
+    e2, _ = make_engine(cfg, seed=99)   # different init — must be overwritten
+    load_path, client = e2.load_checkpoint(str(tmpdir))
+    assert load_path is not None
+    assert client["epoch"] == 3
+    assert e2.global_steps == e1.global_steps
+    assert e2.skipped_steps == e1.skipped_steps
+
+    tree_equal(e1.params, e2.params)
+    if zero:
+        tree_equal(e1.master_flat, e2.master_flat)
+    else:
+        tree_equal(e1.master, e2.master)
+    tree_equal(e1.opt_state, e2.opt_state)
+    tree_equal(e1.loss_scale_state, e2.loss_scale_state)
+    assert (e1.lr_scheduler.state_dict() == e2.lr_scheduler.state_dict())
+
+    # resume parity: both engines continue identically
+    l1 = train(e1, 5, data_seed=7)
+    l2 = train(e2, 5, data_seed=7)
+    np.testing.assert_allclose(l1, l2, rtol=0, atol=0)
+
+
+def test_checkpoint_no_optimizer_states(tmpdir):
+    cfg = base_config()
+    e1, _ = make_engine(cfg)
+    train(e1, 8)
+    e1.save_checkpoint(str(tmpdir))
+
+    e2, _ = make_engine(cfg, seed=99)
+    _, _ = e2.load_checkpoint(str(tmpdir), load_optimizer_states=False)
+    tree_equal(e1.params, e2.params)
+    # fresh optimizer: moments zero, step zero
+    assert int(e2.opt_state.step) == 0
+    for leaf in jax.tree_util.tree_leaves(e2.opt_state.m):
+        assert float(np.abs(np.asarray(leaf)).max()) == 0.0
+    # masters re-derived from fp16 weights
+    tree_equal(e2.master,
+               jax.tree_util.tree_map(lambda p: np.asarray(p, np.float32),
+                                      e1.params), rtol=0, atol=0)
+
+
+def test_zero_load_without_optimizer_states(tmpdir):
+    """ZeRO weights-only fine-tune: masters MUST be re-derived from the
+    loaded weights, or step() silently reverts params to the stale
+    init-time master (the silent-corruption path)."""
+    cfg = base_config(zero_optimization=True)
+    e1, _ = make_engine(cfg)
+    train(e1, 8)
+    e1.save_checkpoint(str(tmpdir))
+
+    e2, _ = make_engine(cfg, seed=99)
+    e2.load_checkpoint(str(tmpdir), load_optimizer_states=False)
+    tree_equal(e1.params, e2.params)
+    assert int(e2.opt_state.step) == 0
+    # flat master rebuilt from loaded (fp16) weights, not the seed-99 init:
+    # matches e1's fp32 master to fp16 round-trip precision
+    n = e1.flat_meta.total
+    np.testing.assert_allclose(np.asarray(e2.master_flat)[:n],
+                               np.asarray(e1.master_flat)[:n],
+                               rtol=1e-3, atol=1e-4)
+    # one more step must move params, continuing from the checkpoint
+    before = np.asarray(jax.tree_util.tree_leaves(e2.params)[0]).copy()
+    train(e2, 1)
+    after = np.asarray(jax.tree_util.tree_leaves(e2.params)[0])
+    assert not np.array_equal(before, after)
+
+
+def test_zero_checkpoint_across_dp_sizes(tmpdir):
+    """Save under dp=8, restore under dp=4 (different partition layout) —
+    the 'different restore topology' case (SURVEY.md §7.3)."""
+    cfg = base_config(zero_optimization=True)
+    e1, _ = make_engine(cfg)
+    train(e1, 10)
+    e1.save_checkpoint(str(tmpdir))
+
+    mesh4 = make_mesh(model_parallel_size=1, devices=jax.devices()[:4])
+    cfg4 = base_config(zero_optimization=True)
+    e2, _ = make_engine(cfg4, seed=99, mesh=mesh4)
+    load_path, _ = e2.load_checkpoint(str(tmpdir))
+    assert load_path is not None
+
+    # same unpadded master content
+    n = e1.flat_meta.total
+    np.testing.assert_array_equal(
+        np.asarray(e1.master_flat)[:n], np.asarray(e2.master_flat)[:n])
+    tree_equal(e1.params, e2.params)
+
+    l1 = train(e1, 5, data_seed=11)
+    l2 = train(e2, 5, data_seed=11)
+    # dp=8 vs dp=4 sum gradients in different orders: bit-exact state, but
+    # continued losses only match to reduction-order fp noise
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+
+def test_load_missing_returns_none(tmpdir):
+    e, _ = make_engine(base_config())
+    path, client = e.load_checkpoint(str(tmpdir))
+    assert path is None and client is None
+
+
+def test_latest_tag_and_explicit_tag(tmpdir):
+    e, _ = make_engine(base_config())
+    train(e, 4)
+    e.save_checkpoint(str(tmpdir), tag="step4")
+    train(e, 4)
+    e.save_checkpoint(str(tmpdir))   # default tag global_step8
+
+    e2, _ = make_engine(base_config(), seed=5)
+    path, _ = e2.load_checkpoint(str(tmpdir))           # latest
+    assert path.endswith("global_step8")
+    assert e2.global_steps == 8
+    e3, _ = make_engine(base_config(), seed=5)
+    path, _ = e3.load_checkpoint(str(tmpdir), tag="step4")
+    assert e3.global_steps == 4

@@ -1,0 +1,60 @@
+"""CSR algebra vs dense reference — port of
+/root/reference/tests/unit/test_csr.py (addition with self and with a
+different sparsity pattern), plus scale/allreduce helpers."""
+
+import numpy as np
+
+from deepspeed_tpu.sparse import CSRTensor, csr_allreduce
+
+
+def random_row_sparse(rows=10, cols=5, seed=1234, p=0.25):
+    rng = np.random.default_rng(seed)
+    x = np.zeros((rows, cols), np.float32)
+    x[0] = 1.0                     # first row always populated
+    for i in range(1, rows):
+        if rng.random() < p:
+            x[i] = 1.0
+    return x
+
+
+def test_csr_addition_self():
+    dense_x = random_row_sparse()
+    cx = CSRTensor(dense_x)
+    np.testing.assert_array_equal(np.asarray(cx.to_dense()), dense_x)
+    cx.add(CSRTensor(dense_x))
+    np.testing.assert_array_equal(np.asarray(cx.to_dense()),
+                                  dense_x + dense_x)
+
+
+def test_csr_addition_different():
+    dense_x = random_row_sparse(seed=1)
+    dense_y = random_row_sparse(seed=2)
+    cx = CSRTensor(dense_x)
+    cx.add(CSRTensor(dense_y))
+    np.testing.assert_array_equal(np.asarray(cx.to_dense()),
+                                  dense_x + dense_y)
+
+
+def test_csr_empty():
+    dense = np.zeros((4, 3), np.float32)
+    c = CSRTensor(dense)
+    np.testing.assert_array_equal(np.asarray(c.to_dense()), dense)
+    nnz, total = c.sparse_size()
+    assert nnz == 0 and total == 12
+
+
+def test_csr_scale_and_sparse_size():
+    dense = random_row_sparse(seed=7)
+    c = CSRTensor(dense)
+    np.testing.assert_allclose(np.asarray(c.scale(0.5).to_dense()),
+                               dense * 0.5)
+    nnz, total = c.sparse_size()
+    assert total == dense.size
+    assert nnz == int((dense.any(axis=1)).sum()) * dense.shape[1]
+
+
+def test_csr_allreduce_matches_dense_mean():
+    shards = [random_row_sparse(seed=s) for s in range(4)]
+    got = np.asarray(csr_allreduce([CSRTensor(s) for s in shards]))
+    want = np.mean(shards, axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)

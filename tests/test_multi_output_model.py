@@ -1,0 +1,98 @@
+"""Multi-output model + grad-accumulation semantics — port of
+/root/reference/tests/unit/test_multi_output_model.py: a model returning a
+TUPLE of losses, trained with gas>1; backward returns the grad-accum-scaled
+loss; micro-batch bookkeeping checked against the batch triangle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+
+
+class MultiOutputModel:
+    """Linear + CE per (input, target) pair, returns tuple of losses
+    (reference multi_output_model.py)."""
+
+    def __init__(self, hidden_dim, weight_value):
+        self.hidden_dim = hidden_dim
+        self.weight_value = weight_value
+
+    def init_params(self, rng):
+        return {"w": jnp.full((self.hidden_dim, self.hidden_dim),
+                              self.weight_value, jnp.float32)}
+
+    def apply(self, params, x0, y0, x1, y1):
+        losses = []
+        for x, y in ((x0, y0), (x1, y1)):
+            logits = x @ params["w"].astype(x.dtype)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            onehot = jax.nn.one_hot(y, self.hidden_dim, dtype=jnp.float32)
+            losses.append(-jnp.mean(jnp.sum(onehot * logp, axis=-1)))
+        return tuple(losses)
+
+
+def make_batch(micro_batch, hidden_dim, inputs=(1.0, 2.0), targets=(1, 2)):
+    out = []
+    for x, y in zip(inputs, targets):
+        out.append(np.full((micro_batch, hidden_dim), x, np.float32))
+        out.append(np.full((micro_batch,), y, np.int64))
+    # interleave to (x0, y0, x1, y1)
+    return out[0], out[1], out[2], out[3]
+
+
+def config(micro, gas, world=8):
+    return {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "train_batch_size": micro * gas * world,
+        "steps_per_print": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.00015}},
+        "fp16": {"enabled": True},
+    }
+
+
+def test_two_output_model():
+    hidden_dim, gas = 10, 2
+    model = MultiOutputModel(hidden_dim, weight_value=0.1)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config(micro=1, gas=gas), model=model,
+        model_parameters=model.init_params(None))
+
+    # with uniform weights every class has equal probability: CE = ln(10)
+    expected_loss = float(np.log(hidden_dim))
+    for step in range(4):
+        batch = make_batch(8, hidden_dim)
+        loss_tuple = engine(*batch)
+        assert isinstance(loss_tuple, tuple) and len(loss_tuple) == 2
+        for loss in loss_tuple:
+            assert np.asarray(loss).shape == ()
+            assert float(loss) == pytest.approx(expected_loss, rel=1e-2)
+
+        summed_loss = sum(jnp.asarray(l) for l in loss_tuple)
+        scaled_loss = engine.backward(summed_loss)
+        expected_scaled = float(summed_loss) / gas
+        assert float(scaled_loss) == pytest.approx(expected_scaled, rel=1e-6)
+        engine.step()
+
+    # gas=2 → 4 micro steps = 2 optimizer steps
+    assert engine.micro_steps == 4
+    assert engine.global_steps == 2
+
+
+def test_three_output_grad_accum_boundary():
+    """Boundary math: only every gas-th micro step advances global_steps."""
+    hidden_dim, gas = 10, 3
+    model = MultiOutputModel(hidden_dim, weight_value=0.1)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config(micro=1, gas=gas), model=model,
+        model_parameters=model.init_params(None))
+    for i in range(6):
+        assert engine.is_gradient_accumulation_boundary() == ((i + 1) % gas == 0)
+        batch = make_batch(8, hidden_dim)
+        loss_tuple = engine(*batch)
+        engine.backward(sum(jnp.asarray(l) for l in loss_tuple))
+        engine.step()
+    assert engine.global_steps == 2
+    assert engine.micro_steps == 6

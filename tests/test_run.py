@@ -1,0 +1,140 @@
+"""Launcher unit tests — port of /root/reference/tests/unit/test_run.py:6-108
+(hostfile parsing, include/exclude filter DSL, mutual-exclusion errors) plus
+world-info codec and the per-node rank mapping."""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import run as dsrun
+from deepspeed_tpu.launcher.launch import global_rank_mapping
+
+
+@pytest.fixture
+def hostfile(tmpdir):
+    p = tmpdir.join("hostfile")
+    p.write("""
+# comment
+worker-0 slots=2
+worker-1 slots=2
+
+worker-2 slots=4
+""")
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    pool = dsrun.fetch_hostfile(hostfile)
+    assert pool == {"worker-0": 2, "worker-1": 2, "worker-2": 4}
+
+
+def test_fetch_hostfile_missing(tmpdir):
+    assert dsrun.fetch_hostfile(str(tmpdir.join("nope"))) is None
+
+
+def test_fetch_hostfile_malformed(tmpdir):
+    p = tmpdir.join("bad")
+    p.write("worker-0 slots=two\n")
+    with pytest.raises(ValueError):
+        dsrun.fetch_hostfile(str(p))
+
+
+def test_fetch_hostfile_duplicate(tmpdir):
+    p = tmpdir.join("dup")
+    p.write("worker-0 slots=2\nworker-0 slots=2\n")
+    with pytest.raises(ValueError):
+        dsrun.fetch_hostfile(str(p))
+
+
+POOL = {"worker-0": 2, "worker-1": 2, "worker-2": 4}
+
+
+def test_no_filter_keeps_all():
+    active = dsrun.parse_inclusion_exclusion(POOL, "", "")
+    assert active == {"worker-0": [0, 1], "worker-1": [0, 1],
+                      "worker-2": [0, 1, 2, 3]}
+
+
+def test_include_whole_host():
+    active = dsrun.parse_inclusion_exclusion(POOL, "worker-1", "")
+    assert active == {"worker-1": [0, 1]}
+
+
+def test_include_slots():
+    active = dsrun.parse_inclusion_exclusion(POOL, "worker-2:0,2", "")
+    assert active == {"worker-2": [0, 2]}
+
+
+def test_include_multiple_nodes():
+    active = dsrun.parse_inclusion_exclusion(
+        POOL, "worker-0@worker-2:1,3", "")
+    assert active == {"worker-0": [0, 1], "worker-2": [1, 3]}
+
+
+def test_exclude_whole_host():
+    active = dsrun.parse_inclusion_exclusion(POOL, "", "worker-1")
+    assert active == {"worker-0": [0, 1], "worker-2": [0, 1, 2, 3]}
+
+
+def test_exclude_slots():
+    active = dsrun.parse_inclusion_exclusion(POOL, "", "worker-2:1,3")
+    assert active == {"worker-0": [0, 1], "worker-1": [0, 1],
+                      "worker-2": [0, 2]}
+
+
+def test_exclude_everything_on_one_host_keeps_others():
+    active = dsrun.parse_inclusion_exclusion(
+        POOL, "", "worker-0@worker-1@worker-2")
+    assert active == {}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(POOL, "worker-0", "worker-1")
+
+
+def test_unknown_host_errors():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(POOL, "worker-9", "")
+
+
+def test_unknown_slot_errors():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(POOL, "worker-0:7", "")
+
+
+def test_duplicate_host_in_filter_errors():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(POOL, "worker-0@worker-0", "")
+
+
+def test_world_info_roundtrip():
+    info = {"worker-0": [0, 1], "worker-1": [0]}
+    assert dsrun.decode_world_info(dsrun.encode_world_info(info)) == info
+
+
+def test_global_rank_mapping():
+    info = {"worker-0": [0, 1], "worker-1": [0], "worker-2": [0, 1, 2]}
+    assert global_rank_mapping(info) == {
+        "worker-0": [0, 1], "worker-1": [2], "worker-2": [3, 4, 5]}
+
+
+def test_end_to_end_local_launch(tmpdir):
+    """dst run.py → launch.py → user script, local fallback path, checking
+    the env contract arrives in the child."""
+    script = tmpdir.join("train.py")
+    script.write("""
+import os, sys
+assert os.environ["DSTPU_NUM_PROCESSES"] == "1"
+assert os.environ["DSTPU_PROCESS_ID"] == "0"
+assert os.environ["RANK"] == "0"
+assert "--local_rank=0" in sys.argv
+print("CHILD_OK")
+""")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.run",
+         "--hostfile", str(tmpdir.join("missing")), str(script)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    assert "CHILD_OK" in out.stdout
