@@ -103,32 +103,51 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if engine.save_zero_checkpoint:
         _save_zero_checkpoint(engine, save_dir, tag)
 
-    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-        f.write(tag)
+    # only the dp-leader advances the pointer, after its own writes are done
+    # (other ranks racing the pointer could publish a half-written tag)
+    if jax.process_index() == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(tag)
     return path
+
+
+def _addressable_partitions(arr) -> dict:
+    """offset → np slice for the shards THIS process holds (replica 0 only).
+    Multi-host safe: never materialises the non-addressable global array."""
+    out = {}
+    for s in arr.addressable_shards:
+        if s.replica_id != 0:
+            continue
+        idx = s.index[0] if s.index else slice(None)
+        out[idx.start or 0] = np.asarray(s.data)
+    return out
 
 
 def _save_zero_checkpoint(engine, save_dir: str, tag: str) -> None:
     """Per-partition optimizer shards (reference _save_zero_checkpoint
-    :1116-1127).  Slices are taken from the flat padded arrays; the trailing
+    :1116-1127).  Each process writes ONLY the partitions it owns (the
+    reference's every-partition-owner-saves role, :338-343); the trailing
     padding is dropped so restores re-pad for their own topology."""
     meta = engine.flat_meta
     dp = engine.dp_world_size
     part = meta.partition
-    flat_master = np.asarray(engine.master_flat)
-    flat_m = np.asarray(engine.opt_state.m["flat"])
-    flat_v = np.asarray(engine.opt_state.v["flat"])
+    masters = _addressable_partitions(engine.master_flat)
+    ms = _addressable_partitions(engine.opt_state.m["flat"])
+    vs = _addressable_partitions(engine.opt_state.v["flat"])
     step = np.asarray(engine.opt_state.step)
     for r in range(dp):
         lo, hi = r * part, min((r + 1) * part, meta.total)
+        if lo not in masters:
+            continue               # another process owns this partition
+        count = max(hi - lo, 0)
         shard = {
             "partition_id": r,
             "dp_world_size": dp,
             "unpadded_total": meta.total,
             "step": step,
-            "master": flat_master[lo:max(hi, lo)],
-            "m": flat_m[lo:max(hi, lo)],
-            "v": flat_v[lo:max(hi, lo)],
+            "master": masters[lo][:count],
+            "m": ms[lo][:count],
+            "v": vs[lo][:count],
         }
         _save_obj(zero_file(save_dir, tag, r), shard)
 
@@ -180,6 +199,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         if engine.zero_enabled:
             _load_zero_checkpoint(engine, load_dir, tag)
             restored_masters = True
+        elif state.get("zero_enabled"):
+            raise ValueError(
+                "checkpoint was saved with zero_optimization enabled (its "
+                "optimizer state lives in zero_pp_rank_* shards) but this "
+                "engine has ZeRO off — enable zero_optimization, or pass "
+                "load_optimizer_states=False for a weights-only load")
         elif state.get("optimizer") is not None:
             opt = state["optimizer"]
             engine.master = jax.tree_util.tree_map(
@@ -230,14 +255,16 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
     saved under ANY dp world size, re-pad for the current topology
     (reference _load_zero_checkpoint :1034-1046 requires matching topology;
     we lift that restriction)."""
-    shards = []
-    r = 0
-    while os.path.exists(zero_file(load_dir, tag, r)):
-        shards.append(_load_obj(zero_file(load_dir, tag, r)))
-        r += 1
-    if not shards:
+    first = zero_file(load_dir, tag, 0)
+    if not os.path.exists(first):
         raise FileNotFoundError(
             f"no zero checkpoint shards under {load_dir}/{tag}")
+    shard0 = _load_obj(first)
+    # trust the recorded dp_world_size, not directory probing — stale shards
+    # from an earlier save of the same tag under a larger dp must be ignored
+    saved_dp = int(shard0["dp_world_size"])
+    shards = [shard0] + [
+        _load_obj(zero_file(load_dir, tag, r)) for r in range(1, saved_dp)]
     meta = engine.flat_meta
     total = int(shards[0]["unpadded_total"])
     if total != meta.total:

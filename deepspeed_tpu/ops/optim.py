@@ -54,7 +54,11 @@ def _zeros_like_tree(params):
 @dataclasses.dataclass(frozen=True)
 class Optimizer:
     """Base: hyperparameters are static fields; ``lr``/betas may be overridden
-    per step (the LR scheduler's param_group mutation path)."""
+    per step (the LR scheduler's param_group mutation path).
+
+    ``use_pallas``: None = auto (fused Pallas kernels on TPU for leaves of at
+    least one tile), True/False = force.  The Pallas path is the
+    ``csrc/fused_lamb_cuda`` equivalent (ops/pallas_optim.py)."""
     lr: float = 1e-3
     beta1: float = 0.9
     beta2: float = 0.999
@@ -62,6 +66,7 @@ class Optimizer:
     weight_decay: float = 0.0
     bias_correction: bool = True
     eps_inside_sqrt: bool = False  # eps_mode 0 if True (kernel adamMode_t)
+    use_pallas: Optional[bool] = None
     name: str = "base"
 
     def init(self, params) -> OptimizerState:
@@ -118,6 +123,15 @@ class Adam(Optimizer):
         def leaf(p, g, m, v):
             if g is None:
                 return p, m, v
+            from deepspeed_tpu.ops import pallas_optim as pk
+            if pk.should_use_pallas(p.size, self.use_pallas):
+                return pk.fused_adam_update(
+                    p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
+                    weight_decay=self.weight_decay,
+                    combined_scale=combined_scale, step_size=step_size,
+                    lr=lr, eps_inside_sqrt=self.eps_inside_sqrt,
+                    decoupled_decay=self.decoupled_decay,
+                    interpret=not pk.pallas_available())
             m_new, v_new = self._moments(g, m, v, b1, b2, combined_scale)
             upd = m_new / self._denom(v_new)
             if self.weight_decay > 0.0 and not self.decoupled_decay:
@@ -164,6 +178,15 @@ class Lamb(Optimizer):
         def leaf(p, g, m, v):
             if g is None:
                 return p, m, v
+            from deepspeed_tpu.ops import pallas_optim as pk
+            if pk.should_use_pallas(p.size, self.use_pallas):
+                return pk.fused_lamb_update(
+                    p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
+                    weight_decay=self.weight_decay,
+                    combined_scale=combined_scale, step_size=step_size,
+                    min_coeff=self.min_coeff, max_coeff=self.max_coeff,
+                    eps_inside_sqrt=self.eps_inside_sqrt,
+                    interpret=not pk.pallas_available())
             m_new, v_new = self._moments(g, m, v, b1, b2, combined_scale)
             upd = m_new / self._denom(v_new) + self.weight_decay * p
             # two L2 reductions of kernel part1/part2

@@ -127,6 +127,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
     (analogous to MASTER_ADDR/WORLD_SIZE/RANK, reference
     deepspeed_launch.py:92-106).  Single-process runs skip initialization.
     """
+    explicit_coordinator = coordinator_address is not None
     if use_mpi:
         info = mpi_discovery()
         coordinator_address = coordinator_address or info["coordinator_address"]
@@ -139,10 +140,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if process_id is None:
         process_id = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
 
-    if num_processes <= 1:
-        # nothing to rendezvous — also covers launcher-spawned 1-process runs
-        # that export DSTPU_COORDINATOR (calling jax.distributed.initialize
-        # here would fail if the XLA backend is already up)
+    if num_processes <= 1 and not explicit_coordinator:
+        # nothing to rendezvous — covers launcher-spawned 1-process runs that
+        # export DSTPU_COORDINATOR (jax.distributed.initialize would fail if
+        # the XLA backend is already up).  An EXPLICITLY passed coordinator
+        # still rendezvouses: the caller asked for it, and skipping would
+        # silently split a multi-host job into isolated worlds.
         logger.info("init_distributed: single-process run, skipping rendezvous")
         return
 

@@ -172,6 +172,23 @@ def test_zero_checkpoint_across_dp_sizes(tmpdir):
     np.testing.assert_allclose(l1, l2, rtol=1e-4)
 
 
+def test_zero_checkpoint_into_nonzero_engine_errors(tmpdir):
+    """Loading a ZeRO-saved checkpoint into a non-ZeRO engine with
+    load_optimizer_states=True must fail loudly, not silently reset the
+    optimizer."""
+    e1, _ = make_engine(base_config(zero_optimization=True))
+    train(e1, 4)
+    e1.save_checkpoint(str(tmpdir))
+
+    e2, _ = make_engine(base_config(zero_optimization=False), seed=9)
+    with pytest.raises(ValueError, match="zero_optimization"):
+        e2.load_checkpoint(str(tmpdir))
+    # weights-only load is the sanctioned escape hatch
+    path, _ = e2.load_checkpoint(str(tmpdir), load_optimizer_states=False)
+    assert path is not None
+    tree_equal(e1.params, e2.params)
+
+
 def test_load_missing_returns_none(tmpdir):
     e, _ = make_engine(base_config())
     path, client = e.load_checkpoint(str(tmpdir))
