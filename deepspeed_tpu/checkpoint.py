@@ -103,8 +103,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if engine.save_zero_checkpoint:
         _save_zero_checkpoint(engine, save_dir, tag)
 
-    # only the dp-leader advances the pointer, after its own writes are done
-    # (other ranks racing the pointer could publish a half-written tag)
+    # all hosts finish their shard writes BEFORE the dp-leader publishes the
+    # pointer (reference uses dist.barrier around checkpoint dirs,
+    # deepspeed_light.py:1089); otherwise a reader following `latest` could
+    # see a tag whose zero_pp_rank_* shards are still being written
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"dstpu_ckpt_{tag}")
     if jax.process_index() == 0:
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(tag)

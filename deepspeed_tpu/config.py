@@ -174,6 +174,8 @@ class DeepSpeedConfig:
 
         self.model_parallel_size = get_scalar_param(
             pd, C.MODEL_PARALLEL_SIZE, C.MODEL_PARALLEL_SIZE_DEFAULT)
+        self.context_parallel_size = get_scalar_param(
+            pd, C.CONTEXT_PARALLEL_SIZE, C.CONTEXT_PARALLEL_SIZE_DEFAULT)
 
     # ----------------------------------------------------------- batch triangle
 

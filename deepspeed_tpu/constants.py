@@ -156,6 +156,9 @@ MESH_DATA_AXIS = "data"
 MESH_MODEL_AXIS = "model"
 MODEL_PARALLEL_SIZE = "model_parallel_size"
 MODEL_PARALLEL_SIZE_DEFAULT = 1
+MESH_SEQ_AXIS = "seq"
+CONTEXT_PARALLEL_SIZE = "context_parallel_size"
+CONTEXT_PARALLEL_SIZE_DEFAULT = 1
 
 ZERO_PARAMETER_PARALLEL_SIZE = "parameter_parallel_size"
 ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT = None

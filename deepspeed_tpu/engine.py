@@ -47,7 +47,7 @@ from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.data import DeepSpeedDataLoader
 from deepspeed_tpu.ops import optim as optim_mod
 from deepspeed_tpu.parallel import comm
-from deepspeed_tpu.parallel.topology import (DATA_AXIS, MODEL_AXIS,
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
                                              MeshConfig, make_mesh,
                                              init_distributed)
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -182,16 +182,20 @@ class DeepSpeedTpuEngine:
                 raise DeepSpeedConfigError(
                     f"Could not read DeepSpeed config file {cfg_src!r}: {e}")
 
-        # -- mesh (the mpu): explicit Mesh beats config model_parallel_size
+        # -- mesh (the mpu): explicit Mesh beats config parallel sizes
         if isinstance(mesh, MeshConfig):
             mesh = make_mesh(model_parallel_size=mesh.model_parallel_size,
+                             context_parallel_size=mesh.context_parallel_size,
                              devices=mesh.devices)
         if mesh is None:
             mesh = make_mesh(
-                model_parallel_size=cfg_src.get(C.MODEL_PARALLEL_SIZE, 1))
+                model_parallel_size=cfg_src.get(C.MODEL_PARALLEL_SIZE, 1),
+                context_parallel_size=cfg_src.get(
+                    C.CONTEXT_PARALLEL_SIZE, 1))
         self.mesh = mesh
         self.dp_world_size = mesh.shape[DATA_AXIS]
         self.mp_world_size = mesh.shape[MODEL_AXIS]
+        self.sp_world_size = mesh.shape.get(SEQ_AXIS, 1)
 
         self.config = DeepSpeedConfig(cfg_src, dp_world_size=self.dp_world_size)
 
@@ -494,10 +498,19 @@ class DeepSpeedTpuEngine:
         return fn if fn is not None else self.module
 
     def _batch_specs(self, batch):
+        sp = self.sp_world_size
+
         def spec(leaf):
             arr = np.asarray(leaf) if not hasattr(leaf, "ndim") else leaf
+            if arr.ndim >= 2 and sp > 1:
+                # [batch, seq, ...]: tokens shard over the sequence ring
+                return P(DATA_AXIS, SEQ_AXIS)
             return P(DATA_AXIS) if arr.ndim >= 1 else P()
         return jax.tree_util.tree_map(spec, batch)
+
+    def _loss_axes(self):
+        return ((DATA_AXIS, SEQ_AXIS) if self.sp_world_size > 1
+                else DATA_AXIS)
 
     def _grad_stack_specs(self):
         return jax.tree_util.tree_map(lambda s: P(DATA_AXIS, *s),
@@ -551,8 +564,14 @@ class DeepSpeedTpuEngine:
                 loss_fn, has_aux=True)(params)
             loss_out = jax.tree_util.tree_map(
                 lambda l: jax.lax.pmean(jnp.asarray(l, jnp.float32),
-                                        DATA_AXIS), raw_out)
+                                        self._loss_axes()), raw_out)
             grads = self._psum_model_replicated(grads)
+            if self.sp_world_size > 1:
+                # every param is replicated over the sequence ring; the loss
+                # is the pmean of per-shard means, so grads = psum / sp
+                sp = float(self.sp_world_size)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, SEQ_AXIS) / sp, grads)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32)[None], grads)
             return loss_out, grads
@@ -571,7 +590,7 @@ class DeepSpeedTpuEngine:
             out = apply_fn(params, *batch_args)
             return jax.tree_util.tree_map(
                 lambda l: jax.lax.pmean(jnp.asarray(l, jnp.float32),
-                                        DATA_AXIS), out)
+                                        self._loss_axes()), out)
 
         fn = jax.shard_map(
             local, mesh=self.mesh,

@@ -17,7 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import layers as L
 from deepspeed_tpu.models import transformer as T
-from deepspeed_tpu.parallel.topology import MODEL_AXIS
+from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 
 
 BERT_SIZES = {
@@ -61,7 +61,8 @@ def _encode(cfg, params, input_ids, attention_mask, token_type_ids):
     """Embed + encoder stack (runs inside shard_map on local shards)."""
     T_len = input_ids.shape[1]
     x = L.vocab_parallel_embedding(input_ids, params["wte"])
-    x = x + params["wpe"][:T_len].astype(x.dtype)[None]
+    x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
+        x.dtype)[None]
     x = x + jnp.take(params["wtt"].astype(x.dtype), token_type_ids, axis=0)
     x = L.layer_norm(x, params["ln_emb_s"], params["ln_emb_b"], cfg.ln_eps)
     return T.stack_apply(x, params["blocks"], cfg, attn_mask=attention_mask)
@@ -134,10 +135,14 @@ class BertForPreTraining:
         logits = L.vocab_parallel_logits(g, params["wte"])
         logits = logits + params["mlm_bias"].astype(logits.dtype)
         tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_labels)
-        mask = (mlm_labels >= 0).astype(jnp.float32)
-        loss = jnp.sum(tok_loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        loss = L.masked_mean_loss(tok_loss, mlm_labels >= 0)
 
         if self.use_nsp and nsp_labels is not None:
+            if L.axis_size_or_1(SEQ_AXIS) > 1:
+                raise NotImplementedError(
+                    "NSP pools the global [CLS] token, which lives only on "
+                    "sequence shard 0 — NSP is not supported under "
+                    "context_parallel_size > 1")
             pooled = jnp.tanh(x[:, 0] @ params["pool_w"].astype(x.dtype)
                               + params["pool_b"].astype(x.dtype))
             nsp_logits = (pooled @ params["nsp_w"].astype(pooled.dtype)
@@ -191,6 +196,11 @@ class BertForQuestionAnswering:
     def apply(self, params, input_ids, attention_mask, token_type_ids,
               start_positions, end_positions):
         cfg = self.config
+        if L.axis_size_or_1(SEQ_AXIS) > 1:
+            raise NotImplementedError(
+                "span extraction softmaxes over the FULL sequence and "
+                "indexes global positions — not supported under "
+                "context_parallel_size > 1 (fine-tune lengths don't need it)")
         x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
         logits = (x @ params["qa_w"].astype(x.dtype)
                   + params["qa_b"].astype(x.dtype)).astype(jnp.float32)

@@ -90,12 +90,12 @@ class GPT2:
         cfg = self.config
         T_len = tokens.shape[1]
         x = L.vocab_parallel_embedding(tokens, params["wte"])
-        x = x + params["wpe"][:T_len].astype(x.dtype)[None]
+        x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
+            x.dtype)[None]
         x = T.stack_apply(x, params["blocks"], cfg)
         x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
         logits = L.vocab_parallel_logits(x, params["wte"])
         loss = L.vocab_parallel_cross_entropy(logits, labels)
-        mask = (labels >= 0).astype(jnp.float32)
-        return jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return L.masked_mean_loss(loss, labels >= 0)
 
     __call__ = apply

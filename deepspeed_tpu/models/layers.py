@@ -28,7 +28,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.parallel.topology import MODEL_AXIS
+from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
+
+
+def axis_size_or_1(axis) -> int:
+    """Static size of a mesh axis, or 1 when the axis isn't bound (allows
+    the same layer code under 2-axis test meshes and the full
+    ('data','seq','model') mesh)."""
+    try:
+        return jax.lax.axis_size(axis)
+    except (NameError, KeyError, ValueError):
+        return 1
 
 
 def column_parallel_linear(x, w_local, b_local=None):
@@ -101,6 +111,33 @@ def vocab_parallel_cross_entropy(logits_local, labels, axis=MODEL_AXIS):
     return jnp.log(sumexp) - tgt
 
 
+def seq_shard_positions(wpe, t_local):
+    """Position embeddings for THIS sequence shard: global offset
+    ``seq_index * t_local`` under context parallelism, 0 otherwise."""
+    pos0 = (jax.lax.axis_index(SEQ_AXIS) * t_local
+            if axis_size_or_1(SEQ_AXIS) > 1 else 0)
+    return jax.lax.dynamic_slice_in_dim(wpe, pos0, t_local)
+
+
+def masked_mean_loss(loss, mask):
+    """Global masked mean of a per-token loss under sequence sharding.
+
+    Returns a value whose pmean over the seq axis equals the TRUE global
+    masked mean (sum of masked losses / total valid count), and whose
+    psum-of-grads/sp under the engine's aggregation yields the true global
+    gradient — valid-token counts may differ per shard (trailing padding,
+    sparse MLM labels).  With sp == 1 this is the plain masked mean.
+    """
+    mask = mask.astype(jnp.float32)
+    local_sum = jnp.sum(loss * mask)
+    local_cnt = jnp.sum(mask)
+    sp = axis_size_or_1(SEQ_AXIS)
+    if sp > 1:
+        total_cnt = jax.lax.psum(local_cnt, SEQ_AXIS)
+        return local_sum * sp / jnp.maximum(total_cnt, 1.0)
+    return local_sum / jnp.maximum(local_cnt, 1.0)
+
+
 def layer_norm(x, scale, bias, eps=1e-5):
     """LayerNorm in fp32 (bf16/fp16 inputs upcast for the moments)."""
     xf = x.astype(jnp.float32)
@@ -137,6 +174,13 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
     n_local = qkv.shape[-1] // (3 * d)
     qkv = qkv.reshape(B, T, n_local, 3, d)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]   # [B,T,n,d]
+
+    if axis_size_or_1(SEQ_AXIS) > 1:
+        # sequence-sharded: exact blockwise attention over the ring
+        from deepspeed_tpu.models.ring_attention import ring_attention
+        ctx = ring_attention(q, k, v, causal=causal, kv_mask=attn_mask)
+        ctx = ctx.reshape(B, T, n_local * d)
+        return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
 
     scores = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))

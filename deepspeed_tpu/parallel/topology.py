@@ -35,6 +35,7 @@ from jax.sharding import Mesh
 logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"      # context/sequence parallelism (ring attention)
 MODEL_AXIS = "model"
 
 _ACTIVE_MESH: Optional[Mesh] = None
@@ -43,30 +44,37 @@ _ACTIVE_MESH: Optional[Mesh] = None
 @dataclasses.dataclass
 class MeshConfig:
     """Declarative mesh request: model_parallel_size chips per model replica,
-    the rest of the slice becomes the data axis."""
+    context_parallel_size chips per sequence ring, the rest of the slice
+    becomes the data axis."""
     model_parallel_size: int = 1
+    context_parallel_size: int = 1
     devices: Optional[Sequence] = None  # default: all visible devices
 
 
 def make_mesh(model_parallel_size: int = 1,
+              context_parallel_size: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build the global ('data', 'model') mesh.
+    """Build the global ('data', 'seq', 'model') mesh.
 
-    The equivalent of constructing DP/MP process groups
-    (reference deepspeed_light.py:63-77 and the Megatron mpu): devices are
-    laid out [data, model] with model innermost so each model-parallel group
-    is a contiguous block of neighbouring chips.
+    The equivalent of constructing DP/MP process groups (reference
+    deepspeed_light.py:63-77 and the Megatron mpu) plus a context-parallel
+    axis the reference lacks (SURVEY.md §2.3 row 22): devices are laid out
+    [data, seq, model] with model innermost so tensor-parallel collectives
+    ride the fastest ICI links, the sequence ring next (ppermute neighbours
+    adjacent), and DP gradient reductions across the remaining dimension.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     mp = int(model_parallel_size)
-    if mp < 1 or n % mp != 0:
+    sp = int(context_parallel_size)
+    if mp < 1 or sp < 1 or n % (mp * sp) != 0:
         raise ValueError(
-            f"model_parallel_size {mp} must divide device count {n}")
-    dp = n // mp
-    arr = np.asarray(devices).reshape(dp, mp)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+            f"model_parallel_size {mp} x context_parallel_size {sp} must "
+            f"divide device count {n}")
+    dp = n // (mp * sp)
+    arr = np.asarray(devices).reshape(dp, sp, mp)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def set_mesh(mesh: Mesh) -> None:
@@ -84,6 +92,10 @@ def data_parallel_size(mesh: Mesh) -> int:
 
 def model_parallel_size(mesh: Mesh) -> int:
     return mesh.shape[MODEL_AXIS]
+
+
+def context_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape.get(SEQ_AXIS, 1)
 
 
 # ------------------------------------------------------------------ bootstrap
