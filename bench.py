@@ -64,17 +64,18 @@ def main():
         loss = engine(ids, mask, tt, mlm)
         engine.backward(loss)
         engine.step()
-        return loss
+        # host read of the loss forces completion of the whole chained step
+        # (block_until_ready alone does not reliably block under the
+        # experimental axon PJRT platform)
+        return float(loss)
 
     # compile + warmup
     step()
     step()
-    jax.block_until_ready(engine.params)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step()
-    jax.block_until_ready(engine.params)
     dt = time.perf_counter() - t0
 
     samples_per_sec = B * steps / dt
