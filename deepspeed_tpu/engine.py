@@ -516,6 +516,19 @@ class DeepSpeedTpuEngine:
         return jax.tree_util.tree_map(lambda s: P(DATA_AXIS, *s),
                                       self._param_specs)
 
+    @staticmethod
+    def _spec_mentions_model(spec) -> bool:
+        """True if a PartitionSpec shards any dim over the model axis."""
+        flat_axes = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, tuple):
+                flat_axes.update(entry)
+            else:
+                flat_axes.add(entry)
+        return MODEL_AXIS in flat_axes
+
     def _psum_model_replicated(self, grads):
         """Megatron rule: grads of params replicated over the model axis need
         a sum over that axis (each shard's autograd only sees its local path);
@@ -526,21 +539,50 @@ class DeepSpeedTpuEngine:
         def fix(g, s):
             if g is None:
                 return None
-            if MODEL_AXIS in jax.tree_util.tree_leaves(tuple(s)):
-                return g
-            flat_axes = set()
-            for entry in s:
-                if entry is None:
-                    continue
-                if isinstance(entry, tuple):
-                    flat_axes.update(entry)
-                else:
-                    flat_axes.add(entry)
-            if MODEL_AXIS in flat_axes:
+            if self._spec_mentions_model(s):
                 return g
             return jax.lax.psum(g, MODEL_AXIS)
 
         return jax.tree_util.tree_map(fix, grads, self._param_specs)
+
+    def _global_overflow_and_sqnorm(self, grads):
+        """Overflow flag + squared grad norm with model-axis agreement.
+
+        The reference MAX-reduces the overflow flag over the model-parallel
+        group (deepspeed_utils.py:62-75) and SUM-reduces squared norms with
+        replicated-parameter dedup (:100-158) so every TP rank takes the same
+        skip/clip decision.  Here: model-sharded leaves (QKV, MLP, vocab
+        embedding) contribute their local slice and are psum'd over ``model``;
+        model-replicated leaves carry identical grads on every shard (after
+        ``_psum_model_replicated``) and are counted once.  Must be called
+        inside shard_map, after the DP reduction.
+        """
+        mp = self.mp_world_size
+        sq_sharded = jnp.zeros((), jnp.float32)
+        sq_repl = jnp.zeros((), jnp.float32)
+        finite = jnp.asarray(True)
+
+        def visit(g, s):
+            nonlocal sq_sharded, sq_repl, finite
+            if g is None:
+                return
+            contrib = jnp.sum(g.astype(jnp.float32) ** 2)
+            if mp > 1 and self._spec_mentions_model(s):
+                sq_sharded = sq_sharded + contrib
+            else:
+                sq_repl = sq_repl + contrib
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+
+        # pair by tree structure (None-leaf-safe), like _psum_model_replicated
+        jax.tree_util.tree_map(visit, grads, self._param_specs,
+                               is_leaf=lambda x: x is None)
+        overflow = jnp.logical_not(finite)
+        if mp > 1:
+            sq_total = sq_repl + jax.lax.psum(sq_sharded, MODEL_AXIS)
+            overflow = comm.overflow_any(overflow, MODEL_AXIS)
+        else:
+            sq_total = sq_repl
+        return overflow, sq_total
 
     def _build_fwdbwd(self, batch):
         apply_fn = self._apply_fn()
@@ -572,6 +614,18 @@ class DeepSpeedTpuEngine:
                 sp = float(self.sp_world_size)
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.psum(g, SEQ_AXIS) / sp, grads)
+            if self.mp_world_size > 1:
+                # differentiating the per-shard replicated loss is
+                # differentiating the SUM of mp identical loss copies: the
+                # collective transposes + the replicated-leaf psum above give
+                # every leaf exactly mp× the true gradient (uniform across
+                # sharded and replicated leaves — verified empirically at
+                # mp=2 and mp=4).  Adam/LAMB are scale-invariant so training
+                # was unaffected, but norms, clipping, and fp16 overflow
+                # thresholds need the true scale (reference grads carry no
+                # MP factor, deepspeed_utils.py:100-158).
+                mp = float(self.mp_world_size)
+                grads = jax.tree_util.tree_map(lambda g: g / mp, grads)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32)[None], grads)
             return loss_out, grads
@@ -672,7 +726,12 @@ class DeepSpeedTpuEngine:
 
     # ------------------------------------------------------------------- step
 
-    def _build_step(self):
+    def _make_step_local(self):
+        """The boundary update on local shards: DP reduction → overflow/norm
+        agreement → (ZeRO-partitioned or replicated) optimizer update →
+        loss-scale FSM.  Shared by the split-API ``step`` and the fused
+        ``train_batch`` program; must run inside shard_map over the mesh.
+        Takes the UNSTACKED local grad tree."""
         opt = self.base_optimizer
         cfg = self.config
         world = self.dp_world_size
@@ -683,10 +742,7 @@ class DeepSpeedTpuEngine:
         cdt = self.policy.compute_dtype
         meta = self.flat_meta
 
-        def local(master, opt_state, acc, ls_state, lr, b1, b2):
-            # acc leaves arrive as [1, ...] local slices
-            grads = jax.tree_util.tree_map(lambda g: g[0], acc)
-
+        def step_local(master, opt_state, grads, ls_state, lr, b1, b2):
             if zero:
                 flat_local = zero_mod.flatten_tree(grads, meta)
                 gpart = comm.reduce_scatter_grads(
@@ -725,9 +781,7 @@ class DeepSpeedTpuEngine:
                     fp32_allreduce=cfg.fp32_allreduce,
                     prescale_gradients=cfg.prescale_gradients,
                     gradient_predivide_factor=cfg.gradient_predivide_factor)
-                overflow = prec.has_overflow(grads)
-                sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                         for g in jax.tree_util.tree_leaves(grads))
+                overflow, sq = self._global_overflow_and_sqnorm(grads)
                 total_norm = jnp.sqrt(sq)
                 combined = prec.combined_unscale_and_clip_factor(
                     total_norm, ls_state, clip) if fp16 else (
@@ -754,6 +808,11 @@ class DeepSpeedTpuEngine:
                     jnp.asarray(overflow, jnp.bool_),
                     total_norm)
 
+        return step_local
+
+    def _step_specs(self):
+        """(master_spec, opt_spec, ls_spec) partition specs for the update."""
+        zero = self.zero_enabled
         master_spec = (P(DATA_AXIS) if zero else self._param_specs)
         opt_spec = optim_mod.OptimizerState(
             step=P(),
@@ -762,7 +821,17 @@ class DeepSpeedTpuEngine:
             v=(P(DATA_AXIS) if zero else self._param_specs)
             if self.opt_state.v is not None else None)
         ls_spec = jax.tree_util.tree_map(lambda _: P(), self.loss_scale_state)
+        return master_spec, opt_spec, ls_spec
 
+    def _build_step(self):
+        step_local = self._make_step_local()
+
+        def local(master, opt_state, acc, ls_state, lr, b1, b2):
+            # acc leaves arrive as [1, ...] local slices
+            grads = jax.tree_util.tree_map(lambda g: g[0], acc)
+            return step_local(master, opt_state, grads, ls_state, lr, b1, b2)
+
+        master_spec, opt_spec, ls_spec = self._step_specs()
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(master_spec, opt_spec, self._grad_stack_specs(),
