@@ -4,11 +4,21 @@ Reference number: 200 samples/s on one V100 at seq-len 128
 (/root/reference/docs/_tutorials/bert-pretraining.md:308-320); the driver's
 BASELINE.json tracks samples/sec/chip, so ``vs_baseline = value / 200``.
 
-Runs the real engine (bf16 + LAMB, the reference's BERT recipe) on however
-many chips are visible (one under the axon tunnel); reports per-chip
-throughput over steady-state steps after compile+warmup.
+Runs the real engine (bf16 + LAMB, the reference's BERT recipe) through the
+fused ``train_batch`` path — one XLA program per optimizer step (lax.scan
+over gas micro-batches), buffers donated, "selective" remat (save qkv +
+pre-GELU ffn; backward replays no matmuls).  The MLM head uses the standard
+masked-positions format (max_predictions_per_seq=20), like the reference's
+BingBert pipeline.  gas=16 with micro-batch 96 mirrors the large-batch LAMB
+recipe (bert-pretraining.md: 16K global batch) and amortises the optimizer
+update.  Steps are queued asynchronously and timed against one final device
+sync, so no host round-trip sits inside the measured region.
 
-Prints ONE json line: {"metric","value","unit","vs_baseline"}.
+Prints ONE json line: {"metric","value","unit","vs_baseline","mfu",...}.
+Env knobs: BENCH_SIZE/BENCH_SEQ/BENCH_BATCH/BENCH_STEPS/BENCH_REMAT/
+BENCH_GAS/BENCH_MAXPRED/BENCH_PALLAS, BENCH_PEAK_TFLOPS (MFU denominator,
+auto-detected from the device kind when unset), BENCH_SWEEP=1 for a
+batch x remat sweep (rows on stderr, best on stdout).
 """
 
 import json
@@ -19,7 +29,59 @@ import time
 import numpy as np
 
 
-def main():
+def _count_params(tree):
+    import jax
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _train_flops_per_sample(n_params, cfg, seq, n_pred, remat):
+    """Approximate matmul FLOPs per sample for one fwd+bwd pass.
+
+    Standard accounting: 6*N_body per token for parameter matmuls (2N fwd +
+    4N bwd) + 12*L*S*H per token for attention score/value matmuls.  The
+    tied vocab projection (V*H) runs only over the n_pred gathered MLM
+    positions.  Full remat replays the forward (+2N_body + 4*L*S*H per
+    token); "selective" replays only the attention einsums (+4*L*S*H).
+    """
+    V, H, Lyr = cfg.vocab_size, cfg.hidden_size, cfg.num_layers
+    n_body = n_params - V * H
+    attn_tok = 12.0 * Lyr * seq * H
+    per_sample = seq * (6.0 * n_body + attn_tok) + n_pred * 6.0 * V * H
+    if remat is True or remat == "full":
+        per_sample += seq * (2.0 * n_body + 4.0 * Lyr * seq * H) \
+            + n_pred * 2.0 * V * H
+    elif remat == "selective":
+        per_sample += seq * 4.0 * Lyr * seq * H
+    return per_sample
+
+
+def _env_pallas():
+    v = os.environ.get("BENCH_PALLAS", "")
+    return None if v == "" else v == "1"
+
+
+# published peak bf16 matmul TFLOP/s by device kind (MFU denominator)
+_PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _peak_tflops():
+    import jax
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = jax.devices()[0].device_kind
+    return _PEAK_BF16_TFLOPS.get(kind, 459.0)
+
+
+def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
+               warmup=2):
     import jax
 
     import deepspeed_tpu
@@ -27,65 +89,123 @@ def main():
     from deepspeed_tpu.parallel.topology import make_mesh
 
     n_chips = jax.device_count()
-    on_tpu = jax.devices()[0].platform == "tpu"
-
-    seq = int(os.environ.get("BENCH_SEQ", "128"))
-    # BERT-large on TPU; shrink via env for CPU smoke runs
-    size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "tiny")
-    batch_per_chip = int(os.environ.get(
-        "BENCH_BATCH", "256" if on_tpu else "8"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-
     model = BertForPreTraining.from_size(size, max_seq_len=max(seq, 128))
     vocab = model.config.vocab_size
 
     engine, _, _, _ = deepspeed_tpu.initialize(
         config={
-            "train_batch_size": batch_per_chip * n_chips,
+            "train_batch_size": batch_per_chip * n_chips * gas,
+            "gradient_accumulation_steps": gas,
             "optimizer": {"type": "Lamb",
                           "params": {"lr": 4e-3, "max_coeff": 0.5,
-                                     "min_coeff": 0.08}},
+                                     "min_coeff": 0.08,
+                                     "use_pallas": _env_pallas()}},
             "bf16": {"enabled": True},
+            "activation_checkpointing": (
+                {"enabled": True, "policy": remat} if isinstance(remat, str)
+                else bool(remat)),
             "steps_per_print": 10 ** 9,
         },
         model=model,
         model_parameters=model.init_params(jax.random.PRNGKey(0)),
         mesh=make_mesh(model_parallel_size=1))
 
+    n_params = _count_params(engine.params)
+
+    # masked-positions MLM batch: the standard BERT pretraining format
+    # (max_predictions_per_seq=20 at seq 128, the reference recipe's shape —
+    # bert-pretraining.md data pipeline)
+    n_pred = int(os.environ.get("BENCH_MAXPRED", "20"))
     rng = np.random.default_rng(0)
-    B = batch_per_chip * n_chips
+    B = batch_per_chip * n_chips * gas
     ids = rng.integers(0, vocab, size=(B, seq)).astype(np.int32)
     mask = np.ones((B, seq), np.int32)
     tt = np.zeros((B, seq), np.int32)
-    mlm = np.full((B, seq), -1, np.int32)
-    mlm[:, ::7] = ids[:, ::7]
+    positions = np.stack([rng.choice(seq, size=n_pred, replace=False)
+                          for _ in range(B)]).astype(np.int32)
+    mlm_ids = np.take_along_axis(ids, positions, axis=1)
+    weights = np.ones((B, n_pred), np.float32)
+    batch = (ids, mask, tt, positions, mlm_ids, weights)
 
-    def step():
-        loss = engine(ids, mask, tt, mlm)
-        engine.backward(loss)
-        engine.step()
-        # host read of the loss forces completion of the whole chained step
-        # (block_until_ready alone does not reliably block under the
-        # experimental axon PJRT platform)
-        return float(loss)
+    # compile + warmup (forced to completion by the loss read)
+    for _ in range(warmup):
+        loss = engine.train_batch(batch)
+    first_loss = float(loss)
 
-    # compile + warmup
-    step()
-    step()
-
+    # timed: queue all steps, sync once at the end (the final loss read
+    # forces the whole dispatch chain; per-step host reads would serialize)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = step()
+        loss = engine.train_batch(batch)
+    last_loss = float(loss)
     dt = time.perf_counter() - t0
+
+    if not (np.isfinite(first_loss) and np.isfinite(last_loss)):
+        raise RuntimeError(
+            f"bench loss not finite: first={first_loss} last={last_loss}")
 
     samples_per_sec = B * steps / dt
     per_chip = samples_per_sec / n_chips
+    flops = _train_flops_per_sample(n_params, model.config, seq, n_pred,
+                                    remat)
+    peak = _peak_tflops() * 1e12
+    mfu = per_chip * flops / peak
+    return {
+        "per_chip": per_chip,
+        "mfu": mfu,
+        "achieved_tflops": per_chip * flops / 1e12,
+        "loss": last_loss,
+        "n_params": n_params,
+    }
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "tiny")
+    batch_per_chip = int(os.environ.get(
+        "BENCH_BATCH", "96" if on_tpu else "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "8" if on_tpu else "4"))
+    gas = int(os.environ.get("BENCH_GAS", "16" if on_tpu else "1"))
+    remat_env = os.environ.get("BENCH_REMAT", "selective")
+    remat = {"0": False, "1": True, "false": False, "true": True}.get(
+        remat_env.lower(), remat_env)   # "selective"/"dots"/"full" pass
+
+    if os.environ.get("BENCH_SWEEP", "0") == "1":
+        best = None
+        for r in (False, "selective", "full"):
+            for b in (batch_per_chip // 2, batch_per_chip, batch_per_chip * 2):
+                try:
+                    res = run_config(size, seq, b, steps, r, gas=gas)
+                except Exception as e:  # OOM etc: report and move on
+                    print(f"sweep remat={r} batch={b}: FAILED {e}",
+                          file=sys.stderr)
+                    continue
+                print(f"sweep remat={r} batch={b}: "
+                      f"{res['per_chip']:.1f} samples/s/chip "
+                      f"mfu={res['mfu']:.3f}", file=sys.stderr)
+                if best is None or res["per_chip"] > best[0]["per_chip"]:
+                    best = (res, r, b)
+        if best is None:
+            raise RuntimeError(
+                "BENCH_SWEEP: every configuration failed (see stderr)")
+        res, remat, batch_per_chip = best
+    else:
+        res = run_config(size, seq, batch_per_chip, steps, remat, gas=gas)
+
     print(json.dumps({
         "metric": "bert_%s_seq%d_pretrain_samples_per_sec_per_chip"
                   % (size, seq),
-        "value": round(per_chip, 2),
+        "value": round(res["per_chip"], 2),
         "unit": "samples/s/chip",
-        "vs_baseline": round(per_chip / 200.0, 3),
+        "vs_baseline": round(res["per_chip"] / 200.0, 3),
+        "mfu": round(res["mfu"], 4),
+        "achieved_tflops": round(res["achieved_tflops"], 1),
+        "batch_per_chip": batch_per_chip,
+        "gas": gas,
+        "remat": remat,
     }))
     return 0
 

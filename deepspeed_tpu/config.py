@@ -163,6 +163,14 @@ class DeepSpeedConfig:
             self.scheduler_name = sched.get(C.SCHEDULER_TYPE, None)
             self.scheduler_params = dict(sched.get(C.SCHEDULER_PARAMS, {}))
 
+        ac = get_scalar_param(pd, C.ACTIVATION_CHECKPOINTING,
+                              C.ACTIVATION_CHECKPOINTING_DEFAULT)
+        self.activation_checkpointing_policy = None   # None | "full" | "dots"
+        if isinstance(ac, Mapping):
+            self.activation_checkpointing_policy = ac.get("policy", None)
+            ac = bool(ac.get("enabled", True))
+        self.activation_checkpointing = ac    # None | bool
+
         self.wall_clock_breakdown = get_scalar_param(
             pd, C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = get_scalar_param(

@@ -131,6 +131,13 @@ WALL_CLOCK_BREAKDOWN_DEFAULT = False
 MEMORY_BREAKDOWN = "memory_breakdown"
 MEMORY_BREAKDOWN_DEFAULT = False
 
+# TPU-specific: per-block activation rematerialisation (the analog of
+# Megatron's --checkpoint-activations the reference trains against,
+# tests/model/Megatron_GPT2/ds_gpt2_test.sh).  None = leave the model's own
+# setting; true/false overrides it.  Accepts {"enabled": bool} too.
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+ACTIVATION_CHECKPOINTING_DEFAULT = None
+
 #############################################
 # TensorBoard (reference deepspeed_constants.py:225-245)
 #############################################

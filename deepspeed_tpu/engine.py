@@ -206,6 +206,24 @@ class DeepSpeedTpuEngine:
         if validate_fn is not None:
             validate_fn(self.mp_world_size)
 
+        # -- activation checkpointing override (config beats the model's own
+        #    remat flag; the reference's analog is Megatron's
+        #    --checkpoint-activations, ds_gpt2_test.sh gpt_options)
+        ac = self.config.activation_checkpointing
+        if ac is not None:
+            mcfg = getattr(model, "config", None)
+            if mcfg is not None and hasattr(mcfg, "remat"):
+                import dataclasses as _dc
+                repl = {"remat": bool(ac)}
+                pol = self.config.activation_checkpointing_policy
+                if pol is not None and hasattr(mcfg, "remat_policy"):
+                    repl["remat_policy"] = pol
+                model.config = _dc.replace(mcfg, **repl)
+            else:
+                logger.warning(
+                    "activation_checkpointing set but the model exposes no "
+                    "remat toggle; ignored")
+
         # -- precision policy
         self.policy = prec.policy_from_config(self.config.fp16_enabled,
                                               self.config.bf16_enabled)
@@ -584,11 +602,15 @@ class DeepSpeedTpuEngine:
             sq_total = sq_repl
         return overflow, sq_total
 
-    def _build_fwdbwd(self, batch):
+    def _make_loss_and_grads(self):
+        """Local (per-shard) loss + fp32 gradient computation shared by the
+        split-API ``forward`` and the fused ``train_batch`` program.  Returns
+        ``f(params, ls_scale, batch_args) -> (loss_out, grads)`` with grads
+        UNSTACKED; must run inside shard_map over the mesh."""
         apply_fn = self._apply_fn()
         gas = float(self.gradient_accumulation_steps())
 
-        def local(params, ls_scale, batch_args):
+        def loss_and_grads(params, ls_scale, batch_args):
             def loss_fn(p):
                 out = apply_fn(p, *batch_args)
                 # multi-output models return a tuple of losses; grads are of
@@ -627,8 +649,18 @@ class DeepSpeedTpuEngine:
                 mp = float(self.mp_world_size)
                 grads = jax.tree_util.tree_map(lambda g: g / mp, grads)
             grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32)[None], grads)
+                lambda g: g.astype(jnp.float32), grads)
             return loss_out, grads
+
+        return loss_and_grads
+
+    def _build_fwdbwd(self, batch):
+        loss_and_grads = self._make_loss_and_grads()
+
+        def local(params, ls_scale, batch_args):
+            loss_out, grads = loss_and_grads(params, ls_scale, batch_args)
+            return loss_out, jax.tree_util.tree_map(
+                lambda g: g[None], grads)
 
         fn = jax.shard_map(
             local, mesh=self.mesh,
@@ -839,7 +871,37 @@ class DeepSpeedTpuEngine:
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P()),
             check_vma=False)
-        return jax.jit(fn)
+        # donate master/opt-state/grad-acc/loss-scale: without donation XLA
+        # double-buffers every optimizer buffer each step.  In fp32 mode the
+        # output params is an identity cast of the output master, which XLA
+        # may alias — donating master would then invalidate the buffer
+        # self.params still references; skip it there (same guard as
+        # _build_train_batch).
+        donate = ((1, 2, 3) if self.policy.compute_dtype == jnp.float32
+                  else (0, 1, 2, 3))
+        return jax.jit(fn, donate_argnums=donate)
+
+    def _post_boundary_bookkeeping(self, overflow):
+        """Counters, overflow-aware LR step, progress + TB reporting after a
+        boundary update (reference deepspeed_light.py:723-788)."""
+        self.global_steps += 1
+        if self.config.fp16_enabled:
+            self.overflow = bool(overflow)   # host sync, boundary-only
+        else:
+            self.overflow = False
+        if self.overflow:
+            self.skipped_steps += 1
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+
+        if self.summary_writer is not None:
+            lr_val = self.optimizer.param_groups[0]["lr"]
+            self.summary_writer.add_scalar(
+                "Train/Samples/lr", float(lr_val),
+                getattr(self, "sample_count", self.global_steps))
 
     def _current_hypers(self):
         g = self.optimizer.param_groups[0]
@@ -871,26 +933,7 @@ class DeepSpeedTpuEngine:
             else:
                 self.master = new_master
             self._acc = None
-            self.global_steps += 1
-
-            if self.config.fp16_enabled:
-                self.overflow = bool(overflow)   # host sync, boundary-only
-            else:
-                self.overflow = False
-            if self.overflow:
-                self.skipped_steps += 1
-            elif self.lr_scheduler is not None:
-                self.lr_scheduler.step()
-
-            if self.global_steps % self.steps_per_print() == 0:
-                self._report_progress(self.global_steps)
-
-            if self.summary_writer is not None:
-                lr_val = self.optimizer.param_groups[0]["lr"]
-                self.summary_writer.add_scalar(
-                    "Train/Samples/lr", float(lr_val),
-                    getattr(self, "sample_count", self.global_steps))
-
+            self._post_boundary_bookkeeping(overflow)
             self.tput_timer.stop(sync_on=self.params)
 
         self.micro_steps += 1
@@ -901,34 +944,103 @@ class DeepSpeedTpuEngine:
 
     # --------------------------------------------------------- fused hot path
 
+    def _build_train_batch(self, batch):
+        """ONE jitted XLA program for the full effective batch: ``lax.scan``
+        over gas micro-steps (fwd+bwd, grads accumulated on device) feeding
+        straight into the boundary update — grads never leave the device and
+        there is a single dispatch per optimizer step (the reference needs
+        gas+1 host round-trips, deepspeed_light.py:603-807; the split API
+        here needed gas fwd dispatches + an accumulate + a step dispatch)."""
+        gas = self.gradient_accumulation_steps()
+        loss_and_grads = self._make_loss_and_grads()
+        step_local = self._make_step_local()
+
+        def local(params, master, opt_state, ls_state, lr, b1, b2,
+                  batch_args):
+            if gas == 1:
+                # no accumulator buffer, no scan machinery
+                last_loss, acc = loss_and_grads(
+                    params, ls_state.cur_scale, batch_args)
+            else:
+                # fold the grad-accum axis out front for the scan; batch
+                # leaves arrive as local [gas * micro_local, ...] slices
+                mb = jax.tree_util.tree_map(
+                    lambda x: x.reshape(
+                        (gas, x.shape[0] // gas) + x.shape[1:]),
+                    batch_args)
+
+                def body(acc, micro):
+                    loss_out, grads = loss_and_grads(
+                        params, ls_state.cur_scale, micro)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return acc, loss_out
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                acc, losses = jax.lax.scan(body, zeros, mb)
+                last_loss = jax.tree_util.tree_map(lambda l: l[-1], losses)
+            (params_new, master_new, opt_new, ls_new, overflow,
+             total_norm) = step_local(master, opt_state, acc, ls_state,
+                                      lr, b1, b2)
+            return (params_new, master_new, opt_new, ls_new, overflow,
+                    total_norm, last_loss)
+
+        master_spec, opt_spec, ls_spec = self._step_specs()
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
+                      P(), P(), P(), self._batch_specs(batch)),
+            out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
+                       P(), P(), P()),
+            check_vma=False)
+        # donate params/master/opt-state/loss-scale (all replaced by outputs).
+        # In fp32 mode params.astype(fp32) is an identity, so XLA aliases the
+        # output params and master buffers — donating either on the next call
+        # would donate a buffer that is also passed as the other argument;
+        # donate only the optimizer/loss-scale state there.
+        donate = ((2, 3) if self.policy.compute_dtype == jnp.float32
+                  else (0, 1, 2, 3))
+        return jax.jit(fn, donate_argnums=donate)
+
     def train_batch(self, batch):
         """Forward+backward+step over a full effective batch whose leaves
-        carry a leading [gas * micro * dp] axis: runs gas micro-steps of the
-        split API host-side.  (A fully fused single-XLA-program variant via
-        ``lax.scan`` is the bench-path upgrade tracked for the perf pass.)"""
-        gas = self.gradient_accumulation_steps()
+        carry a leading [gas * micro * dp] axis, as one fused XLA program.
+
+        Semantics match gas iterations of the split API followed by the
+        boundary step, except sample→(micro-step, DP-shard) assignment: the
+        fused path scans each shard's contiguous rows, the split API slices
+        micro-batches globally.  The summed gradient over the effective batch
+        is identical either way.  Returns the last micro-step's loss."""
+        assert self.training, "train_batch() requires train mode"
         batch = _as_tuple(batch)
-        if gas == 1:
-            loss = self.forward(*batch)
-            self.backward(loss)
-            self.step()
-            return loss
-        # split the global batch into gas micro-batches host-side
-        lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        gas = self.gradient_accumulation_steps()
+        leads = {x.shape[0] for x in jax.tree_util.tree_leaves(batch)}
+        if len(leads) != 1:
+            raise ValueError(
+                f"train_batch: batch leaves disagree on the leading dim "
+                f"({sorted(leads)}); every leaf must carry the same "
+                f"[gas * micro * dp] axis")
+        lead = leads.pop()
         if lead % gas != 0:
             raise ValueError(
                 f"train_batch: leading batch dim {lead} is not divisible by "
                 f"gradient_accumulation_steps={gas}")
-        losses = []
-        for i in range(gas):
-            micro = jax.tree_util.tree_map(
-                lambda x: x[i * (x.shape[0] // gas):(i + 1) * (x.shape[0] // gas)],
-                batch)
-            loss = self.forward(*micro)
-            self.backward(loss)
-            self.step()
-            losses.append(loss)
-        return losses[-1]
+        if self._train_batch_fn is None:
+            self._train_batch_fn = self._build_train_batch(batch)
+        master = self.master_flat if self.zero_enabled else self.master
+        lr, b1, b2 = self._current_hypers()
+        (self.params, new_master, self.opt_state, self.loss_scale_state,
+         overflow, self._last_grad_norm, loss) = self._train_batch_fn(
+            self.params, master, self.opt_state, self.loss_scale_state,
+            lr, b1, b2, batch)
+        if self.zero_enabled:
+            self.master_flat = new_master
+        else:
+            self.master = new_master
+        self.micro_steps += gas
+        self._post_boundary_bookkeeping(overflow)
+        self.tput_timer.stop(sync_on=loss)
+        return loss
 
     # ------------------------------------------------------------- reporting
 

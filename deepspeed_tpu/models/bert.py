@@ -124,18 +124,60 @@ class BertForPreTraining:
                           "nsp_w": P(), "nsp_b": P()})
         return specs
 
-    def apply(self, params, input_ids, attention_mask, token_type_ids,
-              mlm_labels, nsp_labels=None):
+    def _mlm_head(self, params, h):
+        """Dense + LN + tied vocab decoder on [.., H] hidden states."""
         cfg = self.config
-        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
-        # MLM head
-        g = L.gelu(x @ params["mlm_dense_w"].astype(x.dtype)
-                   + params["mlm_dense_b"].astype(x.dtype))
+        g = L.gelu(h @ params["mlm_dense_w"].astype(h.dtype)
+                   + params["mlm_dense_b"].astype(h.dtype))
         g = L.layer_norm(g, params["mlm_ln_s"], params["mlm_ln_b"], cfg.ln_eps)
         logits = L.vocab_parallel_logits(g, params["wte"])
-        logits = logits + params["mlm_bias"].astype(logits.dtype)
-        tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_labels)
-        loss = L.masked_mean_loss(tok_loss, mlm_labels >= 0)
+        return logits + params["mlm_bias"].astype(logits.dtype)
+
+    def apply(self, params, input_ids, attention_mask, token_type_ids, *rest):
+        """Two MLM input formats (both are scalar-loss):
+
+        * dense labels — ``apply(.., mlm_labels[, nsp_labels])`` with
+          ``mlm_labels`` int [B, T], positions < 0 ignored.  Simple, but
+          materialises [B, T, vocab] logits.
+        * masked positions — ``apply(.., mlm_positions, mlm_ids,
+          mlm_weights[, nsp_labels])`` with [B, P] leaves (P = static
+          max_predictions_per_seq): the standard BERT pretraining data
+          format (the reference's BingBert recipe trains this way,
+          docs/_tutorials/bert-pretraining.md).  Gathers the P masked
+          positions BEFORE the vocab projection, so the head costs
+          P/T of the dense variant in both FLOPs and memory.
+        """
+        cfg = self.config
+        if len(rest) in (1, 2):
+            mlm_labels, nsp_labels = rest[0], (rest[1] if len(rest) == 2
+                                               else None)
+            mlm_positions = None
+        elif len(rest) in (3, 4):
+            mlm_positions, mlm_ids, mlm_weights = rest[:3]
+            nsp_labels = rest[3] if len(rest) == 4 else None
+            if L.axis_size_or_1(SEQ_AXIS) > 1:
+                raise NotImplementedError(
+                    "masked-positions MLM gathers global sequence positions "
+                    "— use dense mlm_labels under context_parallel_size > 1")
+        else:
+            raise TypeError(
+                f"BertForPreTraining.apply: expected mlm_labels[, nsp] or "
+                f"mlm_positions, mlm_ids, mlm_weights[, nsp], got "
+                f"{len(rest)} trailing args")
+
+        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
+
+        if mlm_positions is None:
+            logits = self._mlm_head(params, x)
+            tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_labels)
+            loss = L.masked_mean_loss(tok_loss, mlm_labels >= 0)
+        else:
+            h_m = jnp.take_along_axis(
+                x, mlm_positions[..., None].astype(jnp.int32), axis=1)
+            logits = self._mlm_head(params, h_m)          # [B, P, vocab/mp]
+            tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_ids)
+            w = mlm_weights.astype(jnp.float32)
+            loss = jnp.sum(tok_loss * w) / jnp.maximum(jnp.sum(w), 1.0)
 
         if self.use_nsp and nsp_labels is not None:
             if L.axis_size_or_1(SEQ_AXIS) > 1:

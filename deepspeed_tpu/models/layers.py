@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 
@@ -171,6 +172,9 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
     B, T, h = x.shape
     d = h // n_heads_global
     qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)  # [B,T,3h/mp]
+    # named for the "selective" remat policy: saving qkv lets backward
+    # recompute attention (cheap einsums) without replaying the qkv matmul
+    qkv = checkpoint_name(qkv, "qkv")
     n_local = qkv.shape[-1] // (3 * d)
     qkv = qkv.reshape(B, T, n_local, 3, d)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]   # [B,T,n,d]
@@ -182,7 +186,9 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
         ctx = ctx.reshape(B, T, n_local * d)
         return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
 
-    scores = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32)
+    # fp32 accumulation on the MXU (free) instead of a bf16 einsum + upcast
+    scores = jnp.einsum("btnd,bsnd->bnts", q, k,
+                        preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
     if causal:
         cmask = jnp.tril(jnp.ones((T, T), jnp.bool_))

@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import layers as L
@@ -34,6 +35,10 @@ class TransformerConfig:
     pre_ln: bool = True           # GPT-2 pre-LN; BERT uses post-LN
     causal: bool = True
     remat: bool = True            # per-block activation checkpointing
+    # "full": recompute everything in backward (max memory savings, ~33%
+    # extra FLOPs).  "dots": save matmul outputs, recompute only cheap
+    # elementwise/softmax/LN — the usual TPU sweet spot when HBM allows.
+    remat_policy: str = "full"
     init_std: float = 0.02
     ln_eps: float = 1e-5
 
@@ -91,6 +96,9 @@ def block_partition_specs() -> dict:
 
 def _mlp(x, p):
     y = L.column_parallel_linear(x, p["fc_w"], p["fc_b"])
+    # named for the "selective" remat policy: saving the pre-GELU ffn lets
+    # backward recompute only the elementwise GELU, no matmul replay
+    y = checkpoint_name(y, "ffn1")
     y = L.gelu(y)
     return L.row_parallel_linear(y, p["fc2_w"], p["fc2_b"])
 
@@ -118,6 +126,21 @@ def stack_apply(x, stacked_params, cfg: TransformerConfig, attn_mask=None):
     def body(carry, lp):
         return block_apply(carry, lp, cfg, attn_mask), None
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.dots_saveable)
+        elif cfg.remat_policy == "selective":
+            # save qkv + pre-GELU ffn (named above): backward replays no
+            # matmuls, only the attention einsums and elementwise ops
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "qkv", "ffn1"))
+        elif cfg.remat_policy == "full":
+            body = jax.checkpoint(body)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                "(expected 'full', 'dots' or 'selective')")
     x, _ = jax.lax.scan(body, x, stacked_params)
     return x

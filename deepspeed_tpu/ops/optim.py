@@ -275,6 +275,9 @@ def from_config(name: str, params_dict: Optional[dict] = None) -> Optimizer:
             kw[k] = float(p.pop(k))
     if "bias_correction" in p:
         kw["bias_correction"] = bool(p.pop("bias_correction"))
+    if "use_pallas" in p:   # None=auto, True/False=force (TPU fused kernels)
+        up = p.pop("use_pallas")
+        kw["use_pallas"] = None if up is None else bool(up)
     name_l = name.lower()
     if name_l == "adam":
         p.pop("max_grad_norm", None)

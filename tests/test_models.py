@@ -149,6 +149,63 @@ def test_bert_mlm_training():
     assert losses[-1] < losses[0]
 
 
+def test_bert_masked_positions_matches_dense_labels():
+    """The masked-positions MLM format (positions/ids/weights) must produce
+    the same loss as dense [B, T] labels marking the same positions."""
+    model = BertForPreTraining.from_size(
+        "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+        num_layers=2, hidden_size=32, num_heads=4)
+    params = model.init_params(jax.random.PRNGKey(3))
+    ids, mask, tt, mlm_dense = bert_batch(8)
+
+    n_pred = 4
+    rng = np.random.default_rng(7)
+    positions = np.stack([rng.choice(SEQ, size=n_pred, replace=False)
+                          for _ in range(8)]).astype(np.int32)
+    mlm_ids = np.take_along_axis(ids, positions, axis=1)
+    weights = np.ones((8, n_pred), np.float32)
+    dense = np.full((8, SEQ), -1, np.int32)
+    np.put_along_axis(dense, positions, mlm_ids, axis=1)
+
+    for mp in (1, 2):
+        mesh = make_mesh(model_parallel_size=mp)
+
+        def run(*batch):
+            specs = model.partition_specs(params)
+            fn = jax.jit(jax.shard_map(
+                lambda p, *b: model.apply(p, *b), mesh=mesh,
+                in_specs=(specs,) + tuple(
+                    P("data", None) for _ in batch),
+                out_specs=P(), check_vma=False))
+            return float(fn(params, *batch))
+
+        got = run(ids, mask, tt, positions, mlm_ids, weights)
+        want = run(ids, mask, tt, dense)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_remat_policies_same_loss_trajectory():
+    """remat on/off and every policy compute identical losses (remat only
+    changes the backward schedule, not the math)."""
+    def run(ac_cfg):
+        model = tiny_gpt2()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=gpt2_config(1, activation_checkpointing=ac_cfg),
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(7)),
+            mesh=make_mesh(model_parallel_size=1))
+        losses = []
+        for i in range(3):
+            toks, labels = lm_batch(8, seed=i)
+            losses.append(float(engine.train_batch((toks, labels))))
+        return losses
+
+    ref = run(False)
+    for cfg in (True, {"enabled": True, "policy": "dots"},
+                {"enabled": True, "policy": "selective"}):
+        np.testing.assert_allclose(run(cfg), ref, rtol=1e-5, atol=1e-6)
+
+
 def test_bert_nsp_head():
     model = BertForPreTraining.from_size(
         "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
