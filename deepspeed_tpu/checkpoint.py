@@ -2,14 +2,15 @@
 
 TPU-native analog of /root/reference/deepspeed/pt/deepspeed_light.py:949-1127:
 
-* layout   ``<dir>/<tag>/mp_rank_{MP:02d}_model_states.pt`` +
+* layout   ``<dir>/<tag>/mp_rank_{MP:02d}_model_states.pt`` — ONE file per
+           model shard (reference writes per-MP-rank files, :961-967) +
            ``<dir>/<tag>/zero_pp_rank_{DP}_mp_rank_{MP:02d}optim_states.pt``
            (path builders reference :949-967)
-* roles    dp-leader saves the model states, every ZeRO partition owner saves
-           its optimizer shard (reference _configure_checkpointing :329-343).
-           Under single-controller SPMD process 0 plays the dp-leader; the
-           ZeRO flat fp32 master/moments are saved as per-partition slices so
-           the on-disk layout matches the reference's one-file-per-rank.
+* roles    each model shard's states are written by the process holding its
+           replica-0 device shards; every ZeRO partition owner saves its
+           optimizer shard (reference _configure_checkpointing :329-343).
+           All writes go through ``addressable_shards`` — a model-axis-sharded
+           global array is NEVER gathered across hosts.
 * content  model (compute-dtype) weights + fp32 masters, optimizer state,
            loss-scale state, lr-scheduler state, engine counters
            (global_steps/skipped_steps/micro_steps) and arbitrary
@@ -18,12 +19,15 @@ TPU-native analog of /root/reference/deepspeed/pt/deepspeed_light.py:949-1127:
 * resume   fp32 master partitions round-trip bit-exactly (the reference saves
            them for the same reason, zero_optimizer.py:510-513); ZeRO
            checkpoints are saved UNPADDED, so a restore onto a different DP
-           world size re-pads and re-partitions cleanly (the "different
-           restore topology" hard part, SURVEY.md §7.3).
+           world size re-pads and re-partitions cleanly; non-ZeRO model
+           states reassemble from per-MP-rank files and re-shard, so a
+           restore onto a different MP degree also works (both beyond the
+           reference, SURVEY.md §7.3)
 
-Serialization is numpy ``.npz`` per file for arrays + a pickled sidecar dict
-for structure (torch.save-equivalent trust model: only load checkpoints you
-wrote).
+Serialization is a pickled dict of numpy arrays per file, loaded through a
+restricted unpickler that only resolves numpy array/dtype reconstructors and
+builtin containers — unlike ``torch.load``, a checkpoint cannot smuggle
+arbitrary code.
 """
 
 from __future__ import annotations
@@ -35,6 +39,9 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deepspeed_tpu import zero as zero_mod
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
 
 MODEL_FILE = "mp_rank_{mp:02d}_model_states.pt"
 ZERO_FILE = "zero_pp_rank_{dp}_mp_rank_{mp:02d}optim_states.pt"
@@ -50,9 +57,40 @@ def _save_obj(path: str, obj: Any) -> None:
         pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
 
 
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Only numpy array machinery and builtin containers resolve; anything
+    else (os.system, subprocess, __reduce__ payloads) raises.  The format
+    stays torch.save-like on disk without torch.load's arbitrary-code risk
+    (ADVICE.md round 1)."""
+
+    _SAFE = {
+        "builtins": {"dict", "list", "tuple", "set", "frozenset", "complex",
+                     "slice", "bytearray", "range"},
+        "numpy": {"ndarray", "dtype", "bool_", "number", "generic"},
+        "numpy.core.multiarray": {"_reconstruct", "scalar"},
+        "numpy._core.multiarray": {"_reconstruct", "scalar"},
+        "numpy.core.numeric": {"_frombuffer"},
+        "numpy._core.numeric": {"_frombuffer"},
+        "collections": {"OrderedDict"},
+    }
+
+    def find_class(self, module, name):
+        if module == "numpy.dtypes" or module == "numpy.core.numerictypes" \
+                or module == "numpy._core.numerictypes":
+            return super().find_class(module, name)   # dtype classes only
+        if name in self._SAFE.get(module, ()):
+            return super().find_class(module, name)
+        if module == "numpy" and not name.startswith("_"):
+            attr = getattr(np, name, None)
+            if isinstance(attr, type) and issubclass(attr, np.generic):
+                return attr                            # numpy scalar types
+        raise pickle.UnpicklingError(
+            f"checkpoint contains forbidden global {module}.{name}")
+
+
 def _load_obj(path: str) -> Any:
     with open(path, "rb") as f:
-        return pickle.load(f)
+        return _RestrictedUnpickler(f).load()
 
 
 def model_file(ckpt_dir: str, tag: str, mp_rank: int = 0) -> str:
@@ -64,41 +102,125 @@ def zero_file(ckpt_dir: str, tag: str, dp_rank: int, mp_rank: int = 0) -> str:
                         ZERO_FILE.format(dp=dp_rank, mp=mp_rank))
 
 
+# --------------------------------------------------------- per-MP-rank split
+
+def _model_dim(spec) -> Optional[int]:
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        if MODEL_AXIS in axes:
+            return d
+    return None
+
+
+def _collect_mp_states(tree, specs, mp_size: int):
+    """Split a sharded pytree into per-model-rank local trees using ONLY
+    this process's addressable shards (multi-host safe: nothing is gathered).
+
+    Returns ``(local_trees, owned)``: ``local_trees[m]`` is rank m's local
+    slice tree (leaves this process cannot see are None) and ``owned[m]``
+    says whether this process holds the replica-0 copy of every
+    model-sharded leaf of rank m — the write-role rule (the reference's
+    "dp rank 0 of each MP group saves", deepspeed_light.py:329-343)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    spec_leaves = treedef.flatten_up_to(specs)
+    per_rank = [[None] * len(leaves) for _ in range(mp_size)]
+    owned = [True] * mp_size
+    any_sharded = False
+    for i, (leaf, spec) in enumerate(zip(leaves, spec_leaves)):
+        d = _model_dim(spec)
+        if d is None or mp_size == 1:
+            # replicated over the model axis: addressable on every device
+            val = np.asarray(leaf.addressable_shards[0].data)
+            for m in range(mp_size):
+                per_rank[m][i] = val
+        else:
+            any_sharded = True
+            local = leaf.shape[d] // mp_size
+            seen = {}
+            for s in leaf.addressable_shards:
+                m = (s.index[d].start or 0) // local
+                if m not in seen or s.replica_id == 0:
+                    seen[m] = (s, s.replica_id == 0)
+            for m in range(mp_size):
+                if m in seen:
+                    per_rank[m][i] = np.asarray(seen[m][0].data)
+                    owned[m] = owned[m] and seen[m][1]
+                else:
+                    owned[m] = False
+    if not any_sharded:
+        owned = [jax.process_index() == 0] * mp_size
+    trees = [treedef.unflatten(per_rank[m]) for m in range(mp_size)]
+    return trees, owned
+
+
+def _combine_mp_states(local_trees, specs):
+    """Inverse of ``_collect_mp_states`` on the host: one global np tree."""
+    if len(local_trees) == 1:
+        return local_trees[0]
+    return zero_mod.combine_local_trees(local_trees, specs, MODEL_AXIS)
+
+
+# ------------------------------------------------------------------- saving
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None) -> str:
     """Engine-level save (reference save_checkpoint :1048-1114)."""
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.join(save_dir, tag)
-    if engine.save_non_zero_checkpoint or engine.save_zero_checkpoint:
-        os.makedirs(path, exist_ok=True)
+    os.makedirs(path, exist_ok=True)
 
-    if engine.save_non_zero_checkpoint:
-        state = {
-            "module": _to_np(engine.params),
-            "loss_scale_state": _to_np(engine.loss_scale_state._asdict()),
-            "loss_scale_variant": engine._ls_variant,
-            "lr_scheduler": (engine.lr_scheduler.state_dict()
-                             if engine.lr_scheduler is not None
-                             and hasattr(engine.lr_scheduler, "state_dict")
-                             else None),
-            # the live hyperparameters the scheduler wrote into the facade
-            # (torch persists these inside optimizer.state_dict param_groups)
-            "param_groups": [dict(g) for g in engine.optimizer.param_groups],
-            "global_steps": engine.global_steps,
-            "skipped_steps": engine.skipped_steps,
-            "micro_steps": engine.micro_steps,
-            "zero_enabled": engine.zero_enabled,
-            "client_state": dict(client_state or {}),
-        }
+    mp = engine.mp_world_size
+    scalar_state = {
+        "loss_scale_state": _to_np(engine.loss_scale_state._asdict()),
+        "loss_scale_variant": engine._ls_variant,
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None
+                         and hasattr(engine.lr_scheduler, "state_dict")
+                         else None),
+        # the live hyperparameters the scheduler wrote into the facade
+        # (torch persists these inside optimizer.state_dict param_groups)
+        "param_groups": [dict(g) for g in engine.optimizer.param_groups],
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "micro_steps": engine.micro_steps,
+        "zero_enabled": engine.zero_enabled,
+        "mp_world_size": mp,
+        "client_state": dict(client_state or {}),
+    }
+
+    params_mp, owned = _collect_mp_states(engine.params, engine._param_specs,
+                                          mp)
+    if engine.zero_enabled:
+        master_mp = m_mp = v_mp = [None] * mp   # masters live in ZeRO files
+        step_np = None
+    else:
+        master_mp, _ = _collect_mp_states(engine.master, engine._param_specs,
+                                          mp)
+        m_mp = ([None] * mp if engine.opt_state.m is None else
+                _collect_mp_states(engine.opt_state.m,
+                                   engine._param_specs, mp)[0])
+        v_mp = ([None] * mp if engine.opt_state.v is None else
+                _collect_mp_states(engine.opt_state.v,
+                                   engine._param_specs, mp)[0])
+        step_np = np.asarray(engine.opt_state.step)
+
+    for rank in range(mp):
+        if not owned[rank]:
+            continue                    # another process owns this MP shard
+        state = dict(scalar_state)
+        state["mp_rank"] = rank
+        state["module"] = params_mp[rank]
         if engine.zero_enabled:
-            # masters live in the ZeRO files; non-ZeRO path keeps them here
             state["optimizer"] = None
         else:
             state["optimizer"] = {
-                "master": _to_np(engine.master),
-                "opt_state": _to_np(engine.opt_state._asdict()),
+                "master": master_mp[rank],
+                "opt_state": {"step": step_np, "m": m_mp[rank],
+                              "v": v_mp[rank]},
             }
-        _save_obj(model_file(save_dir, tag), state)
+        _save_obj(model_file(save_dir, tag, rank), state)
 
     if engine.save_zero_checkpoint:
         _save_zero_checkpoint(engine, save_dir, tag)
@@ -116,15 +238,27 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     return path
 
 
-def _addressable_partitions(arr) -> dict:
-    """offset → np slice for the shards THIS process holds (replica 0 only).
+def _flat_partitions(arr, part: int) -> dict:
+    """(mp_rank, dp_rank) → np partition for the flat-buffer shards THIS
+    process holds (replica 0 only).  Handles both the 1-D P('data') layout
+    and the ZeRO x MP [mp, local_padded] P('model','data') layout.
     Multi-host safe: never materialises the non-addressable global array."""
     out = {}
     for s in arr.addressable_shards:
         if s.replica_id != 0:
             continue
-        idx = s.index[0] if s.index else slice(None)
-        out[idx.start or 0] = np.asarray(s.data)
+        if arr.ndim == 2:
+            m = s.index[0].start or 0
+            start = s.index[1].start or 0
+            data = np.asarray(s.data)[0]
+        else:
+            m = 0
+            start = (s.index[0].start or 0) if s.index else 0
+            data = np.asarray(s.data)
+        # a device shard may span several logical partitions (e.g. after a
+        # mesh with fewer data shards than dp ranks); split it
+        for off in range(0, data.shape[0], part):
+            out[(m, (start + off) // part)] = data[off:off + part]
     return out
 
 
@@ -136,26 +270,28 @@ def _save_zero_checkpoint(engine, save_dir: str, tag: str) -> None:
     meta = engine.flat_meta
     dp = engine.dp_world_size
     part = meta.partition
-    masters = _addressable_partitions(engine.master_flat)
-    ms = _addressable_partitions(engine.opt_state.m["flat"])
-    vs = _addressable_partitions(engine.opt_state.v["flat"])
+    masters = _flat_partitions(engine.master_flat, part)
+    ms = _flat_partitions(engine.opt_state.m["flat"], part)
+    vs = _flat_partitions(engine.opt_state.v["flat"], part)
     step = np.asarray(engine.opt_state.step)
-    for r in range(dp):
-        lo, hi = r * part, min((r + 1) * part, meta.total)
-        if lo not in masters:
-            continue               # another process owns this partition
-        count = max(hi - lo, 0)
+    for (m, r), master in masters.items():
+        lo = r * part
+        count = int(np.clip(meta.total - lo, 0, part))
         shard = {
             "partition_id": r,
+            "mp_rank": m,
             "dp_world_size": dp,
+            "mp_world_size": engine.mp_world_size,
             "unpadded_total": meta.total,
             "step": step,
-            "master": masters[lo][:count],
-            "m": ms[lo][:count],
-            "v": vs[lo][:count],
+            "master": master[:count],
+            "m": ms[(m, r)][:count],
+            "v": vs[(m, r)][:count],
         }
-        _save_obj(zero_file(save_dir, tag, r), shard)
+        _save_obj(zero_file(save_dir, tag, r, m), shard)
 
+
+# ------------------------------------------------------------------ loading
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
@@ -169,16 +305,23 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         with open(latest) as f:
             tag = f.read().strip()
 
-    mfile = model_file(load_dir, tag)
+    mfile = model_file(load_dir, tag, 0)
     if not os.path.exists(mfile):
         return None, None
     state = _load_obj(mfile)
+    saved_mp = int(state.get("mp_world_size", 1))
+    states = [state] + [_load_obj(model_file(load_dir, tag, r))
+                        for r in range(1, saved_mp)]
 
-    # module weights (compute dtype) — reference :995-1004
+    # module weights (compute dtype), reassembled from the per-MP-rank local
+    # slices and re-sharded for the CURRENT mesh — reference :995-1004
+    # (which requires the same MP degree; the reassembly lifts that)
+    module = _combine_mp_states([s["module"] for s in states],
+                                engine._param_specs)
     engine.params = jax.tree_util.tree_map(
         lambda old, new: jax.device_put(
             jnp.asarray(new, old.dtype), old.sharding),
-        engine.params, state["module"])
+        engine.params, module)
 
     # counters — reference :1014-1017
     engine.global_steps = int(state["global_steps"])
@@ -211,16 +354,23 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 "engine has ZeRO off — enable zero_optimization, or pass "
                 "load_optimizer_states=False for a weights-only load")
         elif state.get("optimizer") is not None:
-            opt = state["optimizer"]
+            master = _combine_mp_states(
+                [s["optimizer"]["master"] for s in states],
+                engine._param_specs)
+            m_trees = [s["optimizer"]["opt_state"]["m"] for s in states]
+            m_tree = (None if m_trees[0] is None
+                      else _combine_mp_states(m_trees, engine._param_specs))
+            v_trees = [s["optimizer"]["opt_state"]["v"] for s in states]
+            v_tree = (None if v_trees[0] is None
+                      else _combine_mp_states(v_trees, engine._param_specs))
             engine.master = jax.tree_util.tree_map(
                 lambda old, new: jax.device_put(
                     jnp.asarray(new, old.dtype), old.sharding),
-                engine.master, opt["master"])
-            sd = opt["opt_state"]
+                engine.master, master)
             engine.opt_state = type(engine.opt_state)(
-                step=jnp.asarray(sd["step"]),
-                m=_put_like(engine.opt_state.m, sd["m"]),
-                v=_put_like(engine.opt_state.v, sd["v"]))
+                step=jnp.asarray(state["optimizer"]["opt_state"]["step"]),
+                m=_put_like(engine.opt_state.m, m_tree),
+                v=_put_like(engine.opt_state.v, v_tree))
             restored_masters = True
     if not restored_masters:
         # weights-only fine-tune (load_optimizer_states=False), or a
@@ -236,8 +386,9 @@ def _rederive_masters(engine) -> None:
     """Rebuild fp32 masters (flat or per-leaf) from engine.params."""
     masters = jax.tree_util.tree_map(
         lambda p: jnp.asarray(p, jnp.float32), engine.params)
-    if engine.zero_enabled:
-        from deepspeed_tpu import zero as zero_mod
+    if engine.zero_enabled and engine.mp_world_size > 1:
+        engine.master_flat = engine._flatten_masters_2d(masters)
+    elif engine.zero_enabled:
         flat = zero_mod.flatten_tree(masters, engine.flat_meta)
         engine.master_flat = jax.device_put(flat,
                                             engine.master_flat.sharding)
@@ -259,45 +410,55 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
     """Reassemble the flat fp32 master + moments from per-partition shards
     saved under ANY dp world size, re-pad for the current topology
     (reference _load_zero_checkpoint :1034-1046 requires matching topology;
-    we lift that restriction)."""
-    first = zero_file(load_dir, tag, 0)
+    we lift the DP restriction — MP must match, like the reference)."""
+    mp = engine.mp_world_size
+    meta = engine.flat_meta
+    first = zero_file(load_dir, tag, 0, 0)
     if not os.path.exists(first):
         raise FileNotFoundError(
             f"no zero checkpoint shards under {load_dir}/{tag}")
     shard0 = _load_obj(first)
+    saved_mp = int(shard0.get("mp_world_size", 1))
+    if saved_mp != mp:
+        raise ValueError(
+            f"zero checkpoint was saved with model_parallel_size={saved_mp}, "
+            f"engine has {mp}: ZeRO flat partitions are per-model-shard and "
+            f"cannot be re-split (load with load_optimizer_states=False for "
+            f"a weights-only restore)")
     # trust the recorded dp_world_size, not directory probing — stale shards
     # from an earlier save of the same tag under a larger dp must be ignored
     saved_dp = int(shard0["dp_world_size"])
-    shards = [shard0] + [
-        _load_obj(zero_file(load_dir, tag, r)) for r in range(1, saved_dp)]
-    meta = engine.flat_meta
-    total = int(shards[0]["unpadded_total"])
+    total = int(shard0["unpadded_total"])
     if total != meta.total:
         raise ValueError(
             f"zero checkpoint has {total} elements, engine expects "
             f"{meta.total} (different model?)")
 
-    def reassemble(key):
-        flat = np.concatenate([np.asarray(s[key]) for s in shards])
+    table = [[_load_obj(zero_file(load_dir, tag, r, m))
+              for r in range(saved_dp)] for m in range(mp)]
+
+    def reassemble(key, m):
+        flat = np.concatenate([np.asarray(s[key]) for s in table[m]])
         assert flat.shape[0] == total, (key, flat.shape, total)
         pad = meta.padded - total
         if pad:
             flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
         return flat
 
-    master = reassemble("master")
-    engine.master_flat = jax.device_put(jnp.asarray(master),
+    def stack(key):
+        if mp == 1:
+            return reassemble(key, 0)
+        return np.stack([reassemble(key, m) for m in range(mp)])
+
+    host_master = stack("master")
+    engine.master_flat = jax.device_put(jnp.asarray(host_master),
                                         engine.master_flat.sharding)
     engine.opt_state = type(engine.opt_state)(
-        step=jnp.asarray(shards[0]["step"]),
-        m={"flat": jax.device_put(jnp.asarray(reassemble("m")),
+        step=jnp.asarray(table[0][0]["step"]),
+        m={"flat": jax.device_put(jnp.asarray(stack("m")),
                                   engine.opt_state.m["flat"].sharding)},
-        v={"flat": jax.device_put(jnp.asarray(reassemble("v")),
+        v={"flat": jax.device_put(jnp.asarray(stack("v")),
                                   engine.opt_state.v["flat"].sharding)})
-    # params re-derived from the restored master (bit-exact resume)
-    from deepspeed_tpu import zero as zero_mod
-    engine.params = jax.tree_util.tree_map(
-        lambda old, new: jax.device_put(new, old.sharding),
-        engine.params,
-        zero_mod.unflatten_tree(jnp.asarray(master), meta,
-                                dtype=engine.policy.compute_dtype))
+    # params re-derived from the HOST copy of the restored master (bit-exact
+    # resume; never device_gets the sharded global array — multi-host safe)
+    engine.params = engine._params_from_master_flat(host_master)

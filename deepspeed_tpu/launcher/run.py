@@ -25,6 +25,7 @@ import base64
 import json
 import logging
 import os
+import shlex
 import shutil
 import subprocess
 import sys
@@ -187,7 +188,7 @@ def _env_exports():
     exports = []
     for var, val in os.environ.items():
         if any(var.startswith(p) for p in EXPORT_ENVS):
-            exports.append(f"export {var}={json.dumps(val)}")
+            exports.append(f"export {var}={shlex.quote(val)}")
     for path in DEEPSPEED_ENVIRONMENT_PATHS:
         env_file = os.path.join(path, DEEPSPEED_ENVIRONMENT_NAME)
         if os.path.isfile(env_file):
@@ -195,7 +196,10 @@ def _env_exports():
                 for line in f.readlines():
                     line = line.strip()
                     if line and not line.startswith("#"):
-                        exports.append(f"export {line}")
+                        key, sep, val = line.partition("=")
+                        exports.append(
+                            f"export {key}={shlex.quote(val)}" if sep
+                            else f"export {line}")
     return exports
 
 
@@ -270,10 +274,10 @@ def main(args=None):
         host_list = ",".join(hosts)
         # %n expands to the pdsh node rank on each target
         remote_cmd = (
-            "; ".join(exports + [f"cd {os.path.abspath(os.getcwd())}"])
-            + "; " + " ".join(launch_cmd)
-            + " --node_rank=%n " + args.user_script + " "
-            + " ".join(args.user_args))
+            "; ".join(exports + [f"cd {shlex.quote(os.path.abspath(os.getcwd()))}"])
+            + "; " + " ".join(map(shlex.quote, launch_cmd))
+            + " --node_rank=%n " + shlex.quote(args.user_script) + " "
+            + " ".join(map(shlex.quote, args.user_args)))
         cmd = ["pdsh", "-f", str(PDSH_MAX_FAN_OUT), "-w", host_list,
                remote_cmd]
         logger.info("cmd=%s", cmd)

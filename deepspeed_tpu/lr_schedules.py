@@ -23,6 +23,8 @@ ONE_CYCLE = "OneCycle"
 WARMUP_LR = "WarmupLR"
 VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR]
 
+CYCLE_MOMENTUM_KEYS = ("cycle_momentum",)
+
 LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
 LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
 LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
@@ -350,15 +352,20 @@ def get_config_from_args(args):
     elif args.lr_schedule == ONE_CYCLE:
         _override_from_args(args, config["params"], [
             CYCLE_MIN_LR, CYCLE_MAX_LR, DECAY_LR_RATE, CYCLE_FIRST_STEP_SIZE,
-            CYCLE_SECOND_STEP_SIZE, DECAY_STEP_SIZE, CYCLE_MOMENTUM_KEYS[0],
+            CYCLE_FIRST_STAIR_COUNT, CYCLE_SECOND_STEP_SIZE,
+            CYCLE_SECOND_STAIR_COUNT, DECAY_STEP_SIZE, CYCLE_MOMENTUM_KEYS[0],
             CYCLE_MIN_MOM, CYCLE_MAX_MOM, DECAY_MOM_RATE])
+        # the -1 CLI defaults are "unset" sentinels (reference
+        # deepspeed_lr_schedules.py:63-83) — don't forward them
+        for key in (CYCLE_FIRST_STAIR_COUNT, CYCLE_SECOND_STEP_SIZE,
+                    CYCLE_SECOND_STAIR_COUNT):
+            if config["params"].get(key, 0) is not None \
+                    and config["params"].get(key, 0) < 0:
+                del config["params"][key]
     else:
         _override_from_args(args, config["params"], [
             WARMUP_MIN_LR, WARMUP_MAX_LR, WARMUP_NUM_STEPS])
     return config, None
-
-
-CYCLE_MOMENTUM_KEYS = ("cycle_momentum",)
 
 
 def get_lr_from_config(config):
@@ -378,8 +385,110 @@ def get_lr_from_config(config):
     return params[WARMUP_MAX_LR], ""
 
 
+# ------------------------------------------- torch-scheduler-name registry
+# The reference instantiates any torch.optim.lr_scheduler.* by config name
+# (deepspeed_light.py:351-354).  These are drop-in equivalents of the common
+# ones, same constructor-arg spellings, host-side like everything above.
+
+class _BaseLRsSchedule:
+    """Shared machinery: captures base LRs at construction, updates groups
+    from ``get_lr`` on each ``step`` (torch _LRScheduler protocol subset)."""
+
+    def __init__(self, optimizer, last_epoch: int = -1):
+        self.optimizer = get_param_groups_holder(optimizer)
+        self.base_lrs = [g["lr"] for g in self.optimizer.param_groups]
+        self.last_epoch = last_epoch
+        self.step()
+
+    def get_lr(self):
+        raise NotImplementedError
+
+    def get_last_lr(self):
+        return self._last_lr
+
+    def step(self, epoch=None):
+        self.last_epoch = (self.last_epoch + 1) if epoch is None else epoch
+        lrs = self.get_lr()
+        for group, lr in zip(self.optimizer.param_groups, lrs):
+            group["lr"] = lr
+        self._last_lr = list(lrs)
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "base_lrs": self.base_lrs}
+
+    def load_state_dict(self, sd):
+        self.last_epoch = sd["last_epoch"]
+        self.base_lrs = list(sd["base_lrs"])
+
+
+class CosineAnnealingLR(_BaseLRsSchedule):
+    """torch.optim.lr_scheduler.CosineAnnealingLR equivalent (closed form)."""
+
+    def __init__(self, optimizer, T_max: int, eta_min: float = 0.0,
+                 last_epoch: int = -1):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self):
+        # torch's closed form is periodic in T_max (no clamp)
+        cos = (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2
+        return [self.eta_min + (base - self.eta_min) * cos
+                for base in self.base_lrs]
+
+
+class StepLR(_BaseLRsSchedule):
+    """torch.optim.lr_scheduler.StepLR equivalent."""
+
+    def __init__(self, optimizer, step_size: int, gamma: float = 0.1,
+                 last_epoch: int = -1):
+        self.decay_step_size = step_size
+        self.gamma = gamma
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self):
+        k = self.last_epoch // self.decay_step_size
+        return [base * (self.gamma ** k) for base in self.base_lrs]
+
+
+class LinearLR(_BaseLRsSchedule):
+    """torch.optim.lr_scheduler.LinearLR equivalent."""
+
+    def __init__(self, optimizer, start_factor: float = 1.0 / 3,
+                 end_factor: float = 1.0, total_iters: int = 5,
+                 last_epoch: int = -1):
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        self.total_iters = total_iters
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_iters)
+        factor = (self.start_factor
+                  + (self.end_factor - self.start_factor)
+                  * t / self.total_iters)
+        return [base * factor for base in self.base_lrs]
+
+
+class ExponentialLR(_BaseLRsSchedule):
+    """torch.optim.lr_scheduler.ExponentialLR equivalent."""
+
+    def __init__(self, optimizer, gamma: float, last_epoch: int = -1):
+        self.gamma = gamma
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self):
+        return [base * (self.gamma ** self.last_epoch)
+                for base in self.base_lrs]
+
+
 SCHEDULES = {
     LR_RANGE_TEST: LRRangeTest,
     ONE_CYCLE: OneCycle,
     WARMUP_LR: WarmupLR,
+    # torch-name fallthrough registry (reference deepspeed_light.py:351-354)
+    "CosineAnnealingLR": CosineAnnealingLR,
+    "StepLR": StepLR,
+    "LinearLR": LinearLR,
+    "ExponentialLR": ExponentialLR,
 }

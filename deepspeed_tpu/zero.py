@@ -53,12 +53,99 @@ def make_flat_meta(params, dp_size: int, align: int = 128) -> FlatMeta:
     only, zero_optimizer.py:20-41; 128 additionally keeps XLA tiling clean)."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     shapes = tuple(tuple(l.shape) for l in leaves)
+    return _meta_from_shapes(treedef, shapes, dp_size, align)
+
+
+def _meta_from_shapes(treedef, shapes, dp_size: int, align: int) -> FlatMeta:
     sizes = tuple(int(np.prod(s)) if len(s) else 1 for s in shapes)
     total = int(sum(sizes))
     chunk = dp_size * align
     padded = ((total + chunk - 1) // chunk) * chunk
     return FlatMeta(treedef=treedef, shapes=shapes, sizes=sizes, total=total,
                     padded=padded, partition=padded // dp_size)
+
+
+def _spec_axes(entry):
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def _local_shape(shape, spec, axis_sizes) -> Tuple[int, ...]:
+    """Per-device-group shape of a leaf under a PartitionSpec: each dim is
+    divided by the product of the mesh-axis sizes sharding it."""
+    out = list(shape)
+    for i, entry in enumerate(spec):
+        if i >= len(out):
+            break
+        for ax in _spec_axes(entry):
+            size = axis_sizes.get(ax, 1)
+            if out[i] % size != 0:
+                raise ValueError(
+                    f"dim {i} of shape {shape} not divisible by mesh axis "
+                    f"{ax!r} (size {size})")
+            out[i] //= size
+    return tuple(out)
+
+
+def make_local_flat_meta(params, specs, axis_sizes, dp_size: int,
+                         align: int = 128) -> FlatMeta:
+    """Flatten layout of the LOCAL (per-model-shard) parameter slices.
+
+    Under ZeRO x tensor parallelism the reference partitions optimizer state
+    within each MP rank's data-parallel group (deepspeed_light.py:63-77,
+    _configure_zero_optimizer :520-531): every model shard keeps a flat fp32
+    master of only ITS slice of the parameters, split over DP.  The local
+    meta describes exactly those slices — model-sharded leaves shrink by the
+    model-axis degree, model-replicated leaves keep their global shape."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec_leaves = treedef.flatten_up_to(specs)
+    shapes = tuple(_local_shape(tuple(l.shape), s, axis_sizes)
+                   for l, s in zip(leaves, spec_leaves))
+    return _meta_from_shapes(treedef, shapes, dp_size, align)
+
+
+def norm_dedup_weights(meta: FlatMeta, specs, model_axis: str,
+                       mp_size: int) -> np.ndarray:
+    """Per-element weights so a model-axis psum of weighted squared norms
+    counts every parameter exactly once (the reference's replicated-parameter
+    dedup, deepspeed_utils.py:100-158): model-sharded leaves contribute
+    distinct slices on every shard (weight 1), model-replicated leaves are
+    identical on every shard (weight 1/mp)."""
+    spec_leaves = meta.treedef.flatten_up_to(specs)
+    pieces = []
+    for spec, size in zip(spec_leaves, meta.sizes):
+        axes = set()
+        for entry in spec:
+            axes.update(_spec_axes(entry))
+        w = 1.0 if model_axis in axes else 1.0 / mp_size
+        pieces.append(np.full((size,), w, np.float32))
+    pad = meta.padded - meta.total
+    if pad:
+        pieces.append(np.zeros((pad,), np.float32))
+    return np.concatenate(pieces)
+
+
+def combine_local_trees(local_trees, specs, model_axis: str):
+    """Reassemble a global pytree from per-model-shard local trees (host
+    side): model-sharded leaves concatenate along their sharded dim,
+    replicated leaves are taken from shard 0."""
+    treedef = jax.tree_util.tree_structure(local_trees[0])
+    spec_leaves = treedef.flatten_up_to(specs)
+    all_leaves = [jax.tree_util.tree_leaves(t) for t in local_trees]
+    out = []
+    for i, spec in enumerate(spec_leaves):
+        dim = None
+        for d, entry in enumerate(spec):
+            if model_axis in _spec_axes(entry):
+                dim = d
+                break
+        if dim is None:
+            out.append(all_leaves[0][i])
+        else:
+            out.append(np.concatenate(
+                [np.asarray(lv[i]) for lv in all_leaves], axis=dim))
+    return treedef.unflatten(out)
 
 
 def flatten_tree(tree, meta: FlatMeta, dtype=jnp.float32) -> jnp.ndarray:
