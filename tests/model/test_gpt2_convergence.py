@@ -1,0 +1,188 @@
+"""Model-scale convergence/parity tier.
+
+The reference's model tier trains Megatron-GPT2 for 1000 steps and asserts
+LM-loss parity against a non-DeepSpeed baseline at rtol 1e-2 over an
+mp x gpus matrix, plus checkpoint resume-parity mid-run
+(/root/reference/tests/model/Megatron_GPT2/run_func_test.py:14-30,169-215,
+run_checkpoint_test.py:46-80).  The TPU analog below:
+
+* a plain-JAX baseline loop (no engine, no sharding, fp32 Adam) trains the
+  SAME GPT-2 config on the SAME synthetic Markov-Zipf corpus;
+* the engine trains it across {mp=1,2} x {zero on/off} x {bf16,fp16} and the
+  final smoothed loss must match the baseline within 1%;
+* a checkpoint saved at the midpoint and resumed in a fresh engine must
+  reproduce the unbroken run's trajectory.
+
+Scaled to CI: hidden 64 x 2 layers x seq 32, 300 steps — big enough that a
+wrong collective, loss-scale FSM, or ZeRO partition visibly diverges, small
+enough for the 8-fake-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.ops import optim as optim_mod
+from deepspeed_tpu.parallel.topology import make_mesh
+
+VOCAB, SEQ = 128, 32
+BATCH = 16
+STEPS = 300
+RESUME_AT = 150
+LR = 3e-3
+
+
+def model_fn():
+    return GPT2.from_size("tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                          num_layers=2, hidden_size=64, num_heads=4)
+
+
+def corpus(steps=STEPS, batch=BATCH, seed=0):
+    """Markov chain with Zipf-ish marginals: next token is a deterministic
+    affine map of the current one 80% of the time, resampled from a Zipf
+    otherwise — learnable bigram structure, so the loss drops well below the
+    unigram entropy and a diverging run is unmistakable."""
+    rng = np.random.default_rng(seed)
+    zipf = 1.0 / np.arange(1, VOCAB + 1)
+    zipf /= zipf.sum()
+    out = []
+    for _ in range(steps):
+        toks = np.empty((batch, SEQ), np.int32)
+        toks[:, 0] = rng.choice(VOCAB, size=batch, p=zipf)
+        for t in range(1, SEQ):
+            det = (toks[:, t - 1] * 31 + 7) % VOCAB
+            noise = rng.choice(VOCAB, size=batch, p=zipf)
+            keep = rng.random(batch) < 0.8
+            toks[:, t] = np.where(keep, det, noise)
+        labels = np.roll(toks, -1, axis=1)
+        labels[:, -1] = -1
+        out.append((toks, labels))
+    return out
+
+
+@pytest.fixture(scope="module")
+def data():
+    return corpus()
+
+
+@pytest.fixture(scope="module")
+def baseline_losses(data):
+    """Plain-JAX training loop: fp32, single device semantics, the engine's
+    own Adam math but none of its machinery — the reference's 'run Megatron
+    without deepspeed' baseline (run_func_test.py:169-215)."""
+    from jax.sharding import PartitionSpec as P
+    model = model_fn()
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32),
+        model.init_params(jax.random.PRNGKey(11)))
+    opt = optim_mod.Adam(lr=LR)
+    state = opt.init(params)
+    # the TP layers use axis_index, so even the single-device baseline runs
+    # under shard_map — over a trivial 1-device mesh, no actual sharding
+    mesh = make_mesh(model_parallel_size=1, devices=jax.devices()[:1])
+
+    def local(params, state, toks, labels):
+        def loss_fn(p):
+            return model.apply(p, toks, labels)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = opt.update(params, grads, state, lr=LR)
+        return new_params, new_state, loss
+
+    step = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  jax.tree_util.tree_map(lambda _: P(), state),
+                  P(), P()),
+        out_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                   jax.tree_util.tree_map(lambda _: P(), state),
+                   P()),
+        check_vma=False))
+
+    losses = []
+    for toks, labels in data:
+        params, state, loss = step(params, state, toks, labels)
+        losses.append(float(loss))
+    return losses
+
+
+def run_engine(data, mp=1, zero=False, precision="bf16", steps=STEPS,
+               engine=None, start=0):
+    if engine is None:
+        engine = make_engine(mp=mp, zero=zero, precision=precision)
+    losses = []
+    for toks, labels in data[start:start + steps]:
+        losses.append(float(engine.train_batch((toks, labels))))
+    return losses, engine
+
+
+def make_engine(mp=1, zero=False, precision="bf16", seed=11):
+    cfg = {
+        "train_batch_size": BATCH,
+        "steps_per_print": 10 ** 6,
+        "optimizer": {"type": "Adam", "params": {"lr": LR}},
+    }
+    if precision == "fp16":
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    elif precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    if zero:
+        cfg["zero_optimization"] = True
+    model = model_fn()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(seed)),
+        mesh=make_mesh(model_parallel_size=mp))
+    return engine
+
+
+def tail_mean(losses, k=20):
+    return float(np.mean(losses[-k:]))
+
+
+@pytest.mark.parametrize("mp,zero,precision", [
+    (1, False, "fp32"),
+    (1, False, "bf16"),
+    (1, False, "fp16"),
+    (2, False, "bf16"),
+    (2, False, "fp16"),
+    (1, True, "fp16"),
+    (2, True, "fp16"),
+    (2, True, "bf16"),
+])
+def test_convergence_matches_baseline(data, baseline_losses, mp, zero,
+                                      precision):
+    """Final smoothed LM loss within 1% of the plain-JAX fp32 baseline
+    (reference asserts rtol 1e-2 on the LM loss curve,
+    run_func_test.py:214)."""
+    losses, engine = run_engine(data, mp=mp, zero=zero, precision=precision)
+    assert all(np.isfinite(losses))
+    base = tail_mean(baseline_losses)
+    got = tail_mean(losses)
+    # sanity: the model actually learned the bigram structure
+    assert got < 0.8 * losses[0]
+    assert abs(got - base) / base < 0.01, (got, base)
+    if precision == "fp16":
+        assert engine.optimizer.cur_scale > 0
+
+
+def test_resume_parity_midrun(data):
+    """Save at step RESUME_AT, restore in a fresh engine, continue: the
+    resumed trajectory must match the unbroken run (reference
+    run_checkpoint_test.py:46-80)."""
+    full, _ = run_engine(data, mp=2, zero=True, precision="fp16")
+
+    first, e1 = run_engine(data, mp=2, zero=True, precision="fp16",
+                           steps=RESUME_AT)
+    import tempfile
+    d = tempfile.mkdtemp()
+    e1.save_checkpoint(d)
+
+    e2 = make_engine(mp=2, zero=True, precision="fp16", seed=77)
+    path, _ = e2.load_checkpoint(d)
+    assert path is not None
+    rest, _ = run_engine(data, engine=e2, steps=STEPS - RESUME_AT,
+                         start=RESUME_AT)
+    np.testing.assert_allclose(first + rest, full, rtol=0, atol=0)
