@@ -113,6 +113,9 @@ class DeepSpeedConfig:
             pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(
             pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.sparse_gradients_max_rows = get_scalar_param(
+            pd, C.SPARSE_GRADIENTS_MAX_ROWS,
+            C.SPARSE_GRADIENTS_MAX_ROWS_DEFAULT)
 
         # zero_optimization is a plain boolean in the reference (v0.1.0,
         # deepspeed_constants.py:137-146); also accept {"stage": N} spelling.
@@ -260,6 +263,12 @@ class DeepSpeedConfig:
         if not self.gradient_accumulation_steps:
             raise DeepSpeedConfigError(
                 "DeepSpeedConfig: gradient_accumulation_steps is not defined")
+        if (self.sparse_gradients_enabled
+                and int(self.sparse_gradients_max_rows) <= 0):
+            raise DeepSpeedConfigError(
+                "DeepSpeedConfig: sparse_gradients_max_rows must be > 0 "
+                f"(got {self.sparse_gradients_max_rows}); a non-positive "
+                "bound would silently force the dense fallback every step")
 
     def _do_warning_check(self):
         """Reference deepspeed_config.py:395-421."""

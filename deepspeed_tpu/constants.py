@@ -62,6 +62,12 @@ DISABLE_ALLGATHER_DEFAULT = False
 
 SPARSE_GRADIENTS = "sparse_gradients"
 SPARSE_GRADIENTS_DEFAULT = False
+# static per-step bound on touched embedding rows for the sparse (indices,
+# values) gather; above it the reduction falls back to a dense psum.  TPU
+# extension knob — the reference's sparse path has no bound because torch
+# sparse tensors are dynamically sized, XLA programs are not.
+SPARSE_GRADIENTS_MAX_ROWS = "sparse_gradients_max_rows"
+SPARSE_GRADIENTS_MAX_ROWS_DEFAULT = 2048
 
 #############################################
 # FP16 support (reference deepspeed_constants.py:84-118)

@@ -298,6 +298,8 @@ class DeepSpeedTpuEngine:
                     "model_parameters is required (or model.init_params(rng))")
             model_parameters = init_fn(jax.random.PRNGKey(seed))
         self._param_specs = self._resolve_param_specs(model, model_parameters)
+        self._sparse_flags = self._resolve_sparse_flags(model,
+                                                        model_parameters)
         self._init_parameters(model_parameters)
 
         # -- optimizer state
@@ -353,6 +355,37 @@ class DeepSpeedTpuEngine:
         if spec_fn is not None:
             return spec_fn(params)
         return jax.tree_util.tree_map(lambda _: P(), params)
+
+    def _resolve_sparse_flags(self, model, params):
+        """Which leaves take the row-sparse gradient reduction.  The
+        reference auto-marks ``nn.Embedding`` weights when
+        ``sparse_gradients`` is on (deepspeed_light.py:170-176); functional
+        pytrees carry no module types, so models declare them via a
+        ``sparse_grad_specs(params) -> pytree[bool]`` hook.  Returns None
+        (all-dense) unless the path is actually usable — with a warning, so
+        the flag is never a silent no-op."""
+        if not self.config.sparse_gradients_enabled:
+            return None
+        if self.zero_enabled:
+            logger.warning(
+                "sparse_gradients is ignored under ZeRO: gradients reduce "
+                "through the flat partition buffer (reference likewise "
+                "routes ZeRO grads densely)")
+            return None
+        fn = getattr(model, "sparse_grad_specs", None)
+        if fn is None:
+            logger.warning(
+                "sparse_gradients=true but the model defines no "
+                "sparse_grad_specs(params) hook (the nn.Embedding "
+                "auto-marking analog); gradients stay dense")
+            return None
+        flags = fn(params)
+        if not any(jax.tree_util.tree_leaves(flags)):
+            logger.warning(
+                "sparse_gradients=true but sparse_grad_specs marked no "
+                "leaves; gradients stay dense")
+            return None
+        return flags
 
     def _named(self, spec):
         return NamedSharding(self.mesh, spec)
@@ -864,6 +897,7 @@ class DeepSpeedTpuEngine:
         zero_2d = zero and mp > 1
         cdt = self.policy.compute_dtype
         meta = self.flat_meta
+        sparse_flags = self._sparse_flags
 
         def step_local(master, opt_state, grads, ls_state, lr, b1, b2, normw):
             if zero:
@@ -928,11 +962,33 @@ class DeepSpeedTpuEngine:
                         v=(jax.tree_util.tree_map(lambda x: x[None], new_opt.v)
                            if new_opt.v is not None else None))
             else:
-                grads = comm.allreduce_grads(
-                    grads, DATA_AXIS, world,
+                knobs = dict(
                     fp32_allreduce=cfg.fp32_allreduce,
                     prescale_gradients=cfg.prescale_gradients,
                     gradient_predivide_factor=cfg.gradient_predivide_factor)
+                if sparse_flags is None:
+                    grads = comm.allreduce_grads(grads, DATA_AXIS, world,
+                                                 **knobs)
+                else:
+                    # marked leaves (embeddings) reduce as gathered
+                    # (indices, values) with a dense-psum fallback
+                    # (reference sparse_allreduce,
+                    # deepspeed_light.py:884-940)
+                    from deepspeed_tpu import sparse as sparse_mod
+
+                    def reduce_one(g, flag):
+                        if g is None:
+                            return None
+                        if flag:
+                            return sparse_mod.sparse_psum(
+                                g, DATA_AXIS, world,
+                                cfg.sparse_gradients_max_rows, **knobs)
+                        return comm.allreduce_grads(g, DATA_AXIS, world,
+                                                    **knobs)
+
+                    grads = jax.tree_util.tree_map(
+                        reduce_one, grads, sparse_flags,
+                        is_leaf=lambda x: x is None)
                 overflow, sq = self._global_overflow_and_sqnorm(grads)
                 total_norm = jnp.sqrt(sq)
                 combined = prec.combined_unscale_and_clip_factor(

@@ -27,39 +27,57 @@ def _tree_map(f, tree):
     return jax.tree_util.tree_map(f, tree, is_leaf=lambda x: x is None)
 
 
+def scaled_reduce(g: jnp.ndarray,
+                  reduce_fn,
+                  world_size: int,
+                  fp32_allreduce: bool = False,
+                  prescale_gradients: bool = False,
+                  gradient_predivide_factor: float = 1.0) -> jnp.ndarray:
+    """The reference's allreduce_bucket scaling envelope
+    (deepspeed_light.py:819-849) around ANY sum-reduction ``reduce_fn``:
+
+      * ``fp32_allreduce``: upcast before the reduce (reference :822-825).
+      * prescale: divide by ``gradient_predivide_factor`` before the reduce,
+        then by ``world/predivide`` after (reference :827-838).
+      * postscale (default): reduce, then divide by world size.
+
+    Single source of truth for the knob semantics — the dense allreduce, the
+    ZeRO reduce-scatter, and the sparse embedding reduction all wrap their
+    collective with this."""
+    orig_dtype = g.dtype
+    if fp32_allreduce:
+        g = g.astype(jnp.float32)
+    if prescale_gradients:
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = reduce_fn(g)
+        if gradient_predivide_factor != world_size:
+            g = g / (world_size / gradient_predivide_factor)
+    else:
+        g = reduce_fn(g)
+        g = g / world_size
+    if fp32_allreduce and g.dtype != orig_dtype:
+        g = g.astype(orig_dtype)
+    return g
+
+
 def allreduce_grads(grads,
                     axis_name: str,
                     world_size: int,
                     fp32_allreduce: bool = False,
                     prescale_gradients: bool = False,
                     gradient_predivide_factor: float = 1.0):
-    """Sum-reduce grads over the DP axis and average.
-
-    Mirrors ``allreduce_bucket`` (reference deepspeed_light.py:819-849):
-      * ``fp32_allreduce``: upcast before the reduce (reference :822-825).
-      * prescale: divide by ``gradient_predivide_factor`` before the reduce,
-        then by ``world/predivide`` after (reference :827-838).
-      * postscale (default): reduce, then divide by world size.
-    The reduction itself lowers to an ICI all-reduce.
-    """
+    """Sum-reduce grads over the DP axis and average (reference
+    ``allreduce_bucket``, deepspeed_light.py:819-849; knob semantics in
+    ``scaled_reduce``).  The reduction lowers to an ICI all-reduce."""
     def reduce_one(g):
         if g is None:
             return None
-        orig_dtype = g.dtype
-        if fp32_allreduce:
-            g = g.astype(jnp.float32)
-        if prescale_gradients:
-            if gradient_predivide_factor != 1.0:
-                g = g / gradient_predivide_factor
-            g = lax.psum(g, axis_name)
-            if gradient_predivide_factor != world_size:
-                g = g / (world_size / gradient_predivide_factor)
-        else:
-            g = lax.psum(g, axis_name)
-            g = g / world_size
-        if fp32_allreduce and orig_dtype != jnp.float32:
-            g = g.astype(orig_dtype)
-        return g
+        return scaled_reduce(
+            g, lambda x: lax.psum(x, axis_name), world_size,
+            fp32_allreduce=fp32_allreduce,
+            prescale_gradients=prescale_gradients,
+            gradient_predivide_factor=gradient_predivide_factor)
 
     return _tree_map(reduce_one, grads)
 
@@ -79,22 +97,14 @@ def reduce_scatter_grads(flat_grad: jnp.ndarray,
     (docs/_posts/2020-03-17-reduce-scatter.md).  Same scaling knobs as
     ``allreduce_grads``.
     """
-    g = flat_grad
-    orig_dtype = g.dtype
-    if fp32_allreduce:
-        g = g.astype(jnp.float32)
-    if prescale_gradients:
-        if gradient_predivide_factor != 1.0:
-            g = g / gradient_predivide_factor
-        g = lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True)
-        if gradient_predivide_factor != world_size:
-            g = g / (world_size / gradient_predivide_factor)
-    else:
-        g = lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True)
-        g = g / world_size
-    if fp32_allreduce and orig_dtype != jnp.float32:
-        g = g.astype(orig_dtype)
-    return g
+    return scaled_reduce(
+        flat_grad,
+        lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                   tiled=True),
+        world_size,
+        fp32_allreduce=fp32_allreduce,
+        prescale_gradients=prescale_gradients,
+        gradient_predivide_factor=gradient_predivide_factor)
 
 
 def allgather_params(partition: jnp.ndarray, axis_name: str) -> jnp.ndarray:
