@@ -1,0 +1,25 @@
+# TPU-host image for deepspeed_tpu (reference Dockerfile analog: the
+# reference ships a CUDA+apex image; the TPU equivalent is jax[tpu] + libtpu).
+# For CPU-only development builds: --build-arg JAX_SPEC="jax".
+FROM python:3.12-slim
+
+ARG JAX_SPEC="jax[tpu] -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        openssh-client pdsh git \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir ${JAX_SPEC} numpy psutil pytest
+
+WORKDIR /opt/deepspeed_tpu
+COPY pyproject.toml README.md ./
+COPY deepspeed_tpu ./deepspeed_tpu
+COPY bin ./bin
+COPY tests ./tests
+COPY docs ./docs
+RUN pip install --no-cache-dir .
+
+# sanity: the package imports and the CLI resolves
+RUN python -c "import deepspeed_tpu" && dst --help >/dev/null
+
+CMD ["/bin/bash"]

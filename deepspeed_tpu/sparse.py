@@ -7,14 +7,15 @@ routes ``nn.Embedding`` gradients through an allgather of (indices, values)
 instead of a dense allreduce (deepspeed_light.py:884-940) because embedding
 grads on commodity interconnects are bandwidth-bound and row-sparse.
 
-On TPU the calculus differs: ICI bandwidth is high enough that XLA's dense
-``psum`` of an embedding gradient is normally FASTER than gather+densify
-(and `scatter_add` generates serialized HBM traffic on the VPU), so the
-engine keeps embedding grads dense under jit and this module exists for API
-parity, host-side gradient inspection, and DCN-crossing edge cases.  The
-``sparse_gradients`` config flag is accepted (constants.py) and documented as
-a no-op optimization under SPMD; `csr_allreduce` implements the reference's
-gather-then-densify semantics for host-level use.
+On TPU the trade-off is explicit: ``sparse_psum`` below is the jit-native
+version of that reduction — a STATICALLY bounded gather of (indices, values)
+with a dense-psum fallback — and the engine routes gradients of leaves a
+model marks via ``sparse_grad_specs`` through it when the
+``sparse_gradients`` config flag is on (engine.py ``_make_step_local``).
+The win condition is a big table with few touched rows per step
+(``world * max_rows << rows``); when the bound can't beat the dense psum the
+function statically degrades to it.  ``CSRTensor``/``csr_allreduce`` keep
+the reference's host-side API for gradient inspection and parity tests.
 """
 
 from __future__ import annotations
@@ -106,6 +107,15 @@ def sparse_psum(g: jnp.ndarray,
 
     rows = g.shape[0]
     max_rows = int(min(max_rows, rows))
+    if world_size * max_rows >= rows:
+        # the gather would move at least as much as the dense all-reduce
+        # (world * max_rows rows vs ~2 * rows) — statically take the psum,
+        # also skipping the per-step mask/top_k/scatter work
+        return comm.scaled_reduce(
+            g, lambda x: jax.lax.psum(x, axis_name), world_size,
+            fp32_allreduce=fp32_allreduce,
+            prescale_gradients=prescale_gradients,
+            gradient_predivide_factor=gradient_predivide_factor)
 
     def reduce_fn(g):
         mask = jnp.any(g != 0, axis=tuple(range(1, g.ndim)))

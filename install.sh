@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Build and install the deepspeed_tpu wheel, locally or across a hostfile
+# fleet.  TPU-native analog of the reference install.sh (build wheel →
+# optional pdsh fan-out): here the fan-out is plain ssh/scp so it works on
+# TPU pods without extra tooling.
+#
+#   ./install.sh                      install locally (pip --user fallback)
+#   ./install.sh -H hostfile          install on every host in the hostfile
+#   ./install.sh --skip-build         reuse an existing dist/ wheel
+set -euo pipefail
+
+HOSTFILE=""
+SKIP_BUILD=0
+PIP_FLAGS=${PIP_FLAGS:-}
+
+usage() {
+  sed -n '2,9p' "$0" | sed 's/^# \{0,1\}//'
+  exit "${1:-0}"
+}
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -H|--hostfile) HOSTFILE="$2"; shift 2 ;;
+    --skip-build)  SKIP_BUILD=1; shift ;;
+    -h|--help)     usage ;;
+    *) echo "unknown argument: $1" >&2; usage 1 ;;
+  esac
+done
+
+cd "$(dirname "$0")"
+
+if [[ $SKIP_BUILD -eq 0 ]]; then
+  echo "== building wheel"
+  rm -rf dist/ build/ deepspeed_tpu.egg-info/
+  # --no-build-isolation: build with the host's setuptools so the build
+  # works on air-gapped TPU pods (no PyPI reachable from workers)
+  python -m pip wheel --no-deps --no-build-isolation -w dist . >/dev/null
+fi
+
+WHEEL=$(ls dist/deepspeed_tpu-*.whl 2>/dev/null | head -1 || true)
+[[ -n "$WHEEL" ]] || { echo "no wheel in dist/ (build failed?)" >&2; exit 1; }
+echo "== wheel: $WHEEL"
+
+install_local() {
+  python -m pip install --force-reinstall $PIP_FLAGS "$WHEEL"
+}
+
+if [[ -z "$HOSTFILE" ]]; then
+  install_local
+  echo "== installed locally"
+  exit 0
+fi
+
+[[ -f "$HOSTFILE" ]] || { echo "hostfile not found: $HOSTFILE" >&2; exit 1; }
+
+# reference hostfile format: "<host> slots=<n>"; comments + blanks ignored
+HOSTS=$(awk '!/^[[:space:]]*(#|$)/ { print $1 }' "$HOSTFILE")
+[[ -n "$HOSTS" ]] || { echo "no hosts in $HOSTFILE" >&2; exit 1; }
+
+RC=0
+for host in $HOSTS; do
+  echo "== installing on $host"
+  if ! scp -q "$WHEEL" "$host:/tmp/$(basename "$WHEEL")" ||
+     ! ssh "$host" "python -m pip install --force-reinstall $PIP_FLAGS /tmp/$(basename "$WHEEL")"; then
+    echo "== FAILED on $host" >&2
+    RC=1
+  fi
+done
+exit $RC

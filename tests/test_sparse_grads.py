@@ -74,9 +74,20 @@ def run(sparse, steps=5, **cfg_over):
 
 
 def test_sparse_reduction_matches_dense():
+    # max_rows=32 keeps world*max_rows (8*32) below VOCAB=512 so the real
+    # gather branch runs (the default 2048 bound statically degrades to the
+    # dense psum at this table size)
     dense, _ = run(False)
-    sparse, engine = run(True)
+    sparse, engine = run(True, sparse_gradients_max_rows=32)
     assert engine._sparse_flags is not None
+    np.testing.assert_allclose(sparse, dense, rtol=1e-6, atol=1e-7)
+
+
+def test_small_table_statically_degrades_to_dense():
+    """With the default bound, world*max_rows >= rows: the path must still
+    be exact (it silently compiles to the plain psum)."""
+    dense, _ = run(False)
+    sparse, _ = run(True)      # default max_rows 2048 >= VOCAB/world
     np.testing.assert_allclose(sparse, dense, rtol=1e-6, atol=1e-7)
 
 
@@ -91,7 +102,8 @@ def test_fallback_when_bound_exceeded():
 def test_sparse_with_clipping_and_fp16():
     dense, _ = run(False, gradient_clipping=0.1,
                    fp16={"enabled": True, "initial_scale_power": 8})
-    sparse, _ = run(True, gradient_clipping=0.1,
+    sparse, _ = run(True, sparse_gradients_max_rows=32,
+                    gradient_clipping=0.1,
                     fp16={"enabled": True, "initial_scale_power": 8})
     np.testing.assert_allclose(sparse, dense, rtol=1e-6, atol=1e-7)
 
@@ -102,7 +114,7 @@ def test_sparse_with_comm_scaling_knobs():
     knobs = dict(fp32_allreduce=True, prescale_gradients=True,
                  gradient_predivide_factor=2.0)
     dense, _ = run(False, **knobs)
-    sparse, _ = run(True, **knobs)
+    sparse, _ = run(True, sparse_gradients_max_rows=32, **knobs)
     np.testing.assert_allclose(sparse, dense, rtol=1e-6, atol=1e-7)
 
 
@@ -143,9 +155,11 @@ def test_sparse_psum_unit():
     mesh = make_mesh(model_parallel_size=1)
     dp = mesh.shape["data"]
     rng = np.random.default_rng(3)
-    g = np.zeros((dp, 64, 4), np.float32)
+    # 512 rows >> dp * max_rows so the gather branch (not the static dense
+    # degradation) is what's under test
+    g = np.zeros((dp, 512, 4), np.float32)
     for d in range(dp):
-        rows = rng.choice(64, size=5, replace=False)
+        rows = rng.choice(512, size=5, replace=False)
         g[d, rows] = rng.normal(size=(5, 4))
 
     def local(x):
