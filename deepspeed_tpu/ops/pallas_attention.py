@@ -1,0 +1,204 @@
+"""Fused multi-head attention kernel (Pallas, TPU).
+
+The XLA path in ``models/layers.py`` materialises the [B, n, T, T] fp32
+score tensor in HBM twice per layer (scores write + softmax read) and again
+in the backward replay — at BERT-large/seq128/batch96 that is ~300 MB of HBM
+traffic per layer that never needed to leave the chip.  This kernel computes
+QK^T → mask → softmax → ·V entirely in VMEM, one program per (batch row,
+head block), with a custom-VJP backward that recomputes the probabilities in
+VMEM and emits dQ/dK/dV in the same pass (the standard flash-attention
+backward algebra; at the supported sequence lengths the whole [hb, T, T]
+score tile fits on chip, so no online-softmax streaming is needed — longer
+sequences fall back to the XLA path or ride the ring-attention sequence
+axis).
+
+Numerics: scores and probabilities are fp32 (max-subtracted softmax); the
+probability·V contraction runs in the input dtype (bf16 on TPU) with fp32
+accumulation — the same contract as the XLA path.
+
+Use ``fused_attention(q, k, v, attn_mask, causal)`` with
+``q/k/v: [B, T, n, d]`` and ``attn_mask: [B, T]`` float (1 = attend; pass
+ones for none); callers gate on ``supported(...)``.  ``interpret=True`` runs
+anywhere (CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# fp32 score-tile budget per program; several such tiles are live in the
+# backward kernel, so keep a healthy margin under the ~16 MB VMEM
+SCORE_TILE_BUDGET = 2 * 1024 * 1024
+
+
+def _head_block(n_heads: int) -> int:
+    # blocks are [bb, hb, T, d]: Mosaic needs every block dim divisible by
+    # (or equal to) the array dim; hb=8 keeps the score tile bounded for
+    # many-head models
+    return 8 if n_heads % 8 == 0 else n_heads
+
+
+def _batch_block(B: int, T: int, hb: int, budget: int) -> int:
+    # enough rows per program to amortise grid/DMA overhead (tiny per-head
+    # programs are latency-bound), bounded by the score-tile budget
+    for bb in (8, 4, 2, 1):
+        if B % bb == 0 and bb * hb * T * T * 4 <= budget:
+            return bb
+    return 1
+
+
+def supported(seq_len: int, n_heads: int, head_dim: int) -> bool:
+    hb = _head_block(n_heads)
+    return (seq_len % 8 == 0 and head_dim % 8 == 0
+            and hb * seq_len * seq_len * 4 <= SCORE_TILE_BUDGET)
+
+
+def _fold(ref):
+    """[bb, hb, T, d] block -> [bb*hb, T, d] (leading-dim reshape is free;
+    Mosaic's matmul supports a single batch dim)."""
+    bb, hb, T, d = ref.shape
+    return ref[...].reshape(bb * hb, T, d)
+
+
+def _scores(q, k, mask, causal, scale):
+    """[bb*hb,T,d] x [bb*hb,T,d] (native dtype) -> masked fp32 [bb*hb,T,T]
+    logits; ``mask`` is already expanded to [bb*hb, T]."""
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale
+    T = q.shape[1]
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        s = jnp.where((col <= row)[None], s, -1e9)
+    s = jnp.where(mask[:, None, :] != 0, s, -1e9)
+    return s
+
+
+def _expand_mask(mask_ref, hb):
+    """[bb, 1, T] mask block -> [bb*hb, T] row mask."""
+    bb, _, T = mask_ref.shape
+    m = jnp.broadcast_to(mask_ref[...], (bb, hb, T))
+    return m.reshape(bb * hb, T)
+
+
+def _softmax(s):
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal, scale):
+    # blocks are [1, hb, T, d] in the heads-first layout: the batched dots
+    # need NO in-VMEM transposes, and inputs stay in their native dtype —
+    # the MXU accumulates in fp32 via preferred_element_type; an explicit
+    # fp32 upcast would quarter the matmul rate
+    bb, hb, T, d = q_ref.shape
+    q = _fold(q_ref)
+    k = _fold(k_ref)
+    v = _fold(v_ref)
+    p = _softmax(_scores(q, k, _expand_mask(mask_ref, hb), causal, scale))
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)            # [bb*hb, T, d]
+    o_ref[...] = o.reshape(bb, hb, T, d).astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, *, causal, scale):
+    bb, hb, T, d = q_ref.shape
+    q = _fold(q_ref)
+    k = _fold(k_ref)
+    v = _fold(v_ref)
+    do = _fold(do_ref)
+    cdt = q.dtype
+    p = _softmax(_scores(q, k, _expand_mask(mask_ref, hb), causal, scale))
+    pc = p.astype(cdt)
+    bdims = ((0,), (0,))
+    # dV = P^T dO   (contract over the query axis, batched)
+    dv = jax.lax.dot_general(pc, do, (((1,), (1,)), bdims),
+                             preferred_element_type=jnp.float32)
+    # dP = dO V^T
+    dp = jax.lax.dot_general(do, v, (((2,), (2,)), bdims),
+                             preferred_element_type=jnp.float32)
+    # dS = P ∘ (dP − rowsum(dP ∘ P)) ; the scale folds into dQ/dK
+    ds = (p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))).astype(cdt)
+    dq = jax.lax.dot_general(ds, k, (((2,), (1,)), bdims),
+                             preferred_element_type=jnp.float32) * scale
+    dk = jax.lax.dot_general(ds, q, (((1,), (1,)), bdims),
+                             preferred_element_type=jnp.float32) * scale
+    dq_ref[...] = dq.reshape(bb, hb, T, d).astype(dq_ref.dtype)
+    dk_ref[...] = dk.reshape(bb, hb, T, d).astype(dk_ref.dtype)
+    dv_ref[...] = dv.reshape(bb, hb, T, d).astype(dv_ref.dtype)
+
+
+def _specs(B, T, n, d, bwd=False):
+    hb = _head_block(n)
+    # the backward keeps ~2x more score-sized tiles live (p, dP, dS)
+    bb = _batch_block(B, T, hb,
+                      SCORE_TILE_BUDGET // (2 if bwd else 1))
+    # kernel layout is heads-first [B, n, T, d] (the public API transposes
+    # on the XLA side, where the copy fuses with the qkv slice)
+    qkv = pl.BlockSpec((bb, hb, T, d), lambda i, j: (i, j, 0, 0))
+    # mask rides as [B, 1, T] so the trailing block dims are (1, T)
+    mask = pl.BlockSpec((bb, 1, T), lambda i, j: (i, 0, 0))
+    return qkv, mask, (B // bb, n // hb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_attention(q, k, v, attn_mask, causal: bool = False,
+                    interpret: bool = False):
+    """q/k/v: [B, T, n, d]; attn_mask: [B, T] float (1 = attend) — pass
+    ``jnp.ones`` for none.  Returns [B, T, n, d] context."""
+    return _fwd(q, k, v, attn_mask, causal, interpret)
+
+
+def _hf(x):
+    """public [B, T, n, d] -> kernel [B, n, T, d] (XLA-side transpose)."""
+    return jnp.moveaxis(x, 2, 1)
+
+
+def _fwd(q, k, v, attn_mask, causal, interpret):
+    B, T, n, d = q.shape
+    qkv_spec, mask_spec, grid = _specs(B, T, n, d)
+    scale = 1.0 / (d ** 0.5)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B, n, T, d), q.dtype),
+        grid=grid,
+        in_specs=[qkv_spec, qkv_spec, qkv_spec, mask_spec],
+        out_specs=qkv_spec,
+        interpret=interpret,
+    )(_hf(q), _hf(k), _hf(v), attn_mask[:, None, :])
+    return jnp.moveaxis(out, 1, 2)
+
+
+def _fused_fwd(q, k, v, attn_mask, causal, interpret):
+    return _fwd(q, k, v, attn_mask, causal, interpret), (q, k, v, attn_mask)
+
+
+def _fused_bwd(causal, interpret, res, g):
+    q, k, v, attn_mask = res
+    B, T, n, d = q.shape
+    qkv_spec, mask_spec, grid = _specs(B, T, n, d, bwd=True)
+    scale = 1.0 / (d ** 0.5)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, causal=causal, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((B, n, T, d), q.dtype),
+                   jax.ShapeDtypeStruct((B, n, T, d), k.dtype),
+                   jax.ShapeDtypeStruct((B, n, T, d), v.dtype)),
+        grid=grid,
+        in_specs=[qkv_spec, qkv_spec, qkv_spec, mask_spec, qkv_spec],
+        out_specs=(qkv_spec, qkv_spec, qkv_spec),
+        interpret=interpret,
+    )(_hf(q), _hf(k), _hf(v), attn_mask[:, None, :], _hf(g))
+    # mask is a float selector, not a trainable input
+    return (jnp.moveaxis(dq, 1, 2), jnp.moveaxis(dk, 1, 2),
+            jnp.moveaxis(dv, 1, 2), jnp.zeros_like(attn_mask))
+
+
+fused_attention.defvjp(_fused_fwd, _fused_bwd)
