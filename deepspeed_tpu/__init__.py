@@ -24,6 +24,7 @@ def initialize(args=None,
                collate_fn=None,
                config=None,
                config_params=None,
+               param_groups=None,
                seed=0):
     """Construct the engine; returns (engine, optimizer, dataloader, lr_scheduler).
 
@@ -46,6 +47,7 @@ def initialize(args=None,
                                 collate_fn=collate_fn,
                                 config=config,
                                 config_params=config_params,
+                                param_groups=param_groups,
                                 seed=seed)
     return_items = [engine,
                     engine.optimizer,

@@ -82,11 +82,20 @@ class OptimizerFacade:
     def __init__(self, engine: "DeepSpeedTpuEngine"):
         self._engine = engine
         base = engine.base_optimizer
-        self.param_groups = [{
-            "lr": base.lr,
-            "betas": (base.beta1, base.beta2),
-            "name": base.name,
-        }]
+        # group 0 is the default (base-optimizer hyperparameters, unmatched
+        # leaves); groups 1..n are the user's param_groups patterns — the
+        # reference's torch param-group list, addressable by LR schedules
+        # with list-valued params (_format_param)
+        self.param_groups = []
+        for d in engine._group_defs:
+            g = {
+                "lr": d.get("lr", base.lr),
+                "betas": tuple(d.get("betas", (base.beta1, base.beta2))),
+                "name": base.name,
+            }
+            if "params" in d:
+                g["params"] = d["params"]    # the defining pattern
+            self.param_groups.append(g)
 
     # loss-scale observables -------------------------------------------------
     @property
@@ -141,6 +150,7 @@ class DeepSpeedTpuEngine:
                  collate_fn: Optional[Callable] = None,
                  config=None,
                  config_params=None,
+                 param_groups=None,
                  seed: int = 0):
         if model is None:
             raise ValueError("deepspeed_tpu.initialize: model is required")
@@ -300,6 +310,13 @@ class DeepSpeedTpuEngine:
         self._param_specs = self._resolve_param_specs(model, model_parameters)
         self._sparse_flags = self._resolve_sparse_flags(model,
                                                         model_parameters)
+        self._group_defs, self._group_ids = self._resolve_param_groups(
+            param_groups, model_parameters)
+        if self.zero_enabled and len(self._group_defs) > 1:
+            raise DeepSpeedConfigError(
+                "param_groups with ZeRO is not supported: the flat "
+                "partition buffer carries one LR (the reference likewise "
+                "builds its ZeRO optimizer from a single flat group)")
         self._init_parameters(model_parameters)
 
         # -- optimizer state
@@ -355,6 +372,56 @@ class DeepSpeedTpuEngine:
         if spec_fn is not None:
             return spec_fn(params)
         return jax.tree_util.tree_map(lambda _: P(), params)
+
+    def _resolve_param_groups(self, defs, params):
+        """Partition param leaves into optimizer groups by path regex.
+
+        ``defs`` is a list of dicts: ``{"params": <regex over the leaf's
+        pytree path>, "lr": ..., "betas": ...}`` — the TPU spelling of
+        torch's param-group list (the reference takes pre-partitioned
+        tensor lists; functional pytrees address leaves by path instead).
+        A leaf joins the FIRST matching group (1-based); unmatched leaves
+        form group 0 with the base optimizer's hyperparameters.  Returns
+        ``(group_defs, group_ids)`` where group_ids is a pytree[int]."""
+        if not defs:
+            return [{}], jax.tree_util.tree_map(lambda _: 0, params)
+        import re
+        for d in defs:
+            if "params" not in d:
+                raise DeepSpeedConfigError(
+                    "each param_groups entry needs a 'params' path regex")
+            extra = set(d) - {"params", "lr"}
+            if extra:
+                # per-group betas/weight_decay are NOT plumbed into the
+                # jitted step (momentum is global, like the reference FP16
+                # wrapper) — rejecting beats silently training with other
+                # hyperparameters than the facade displays
+                raise DeepSpeedConfigError(
+                    f"param_groups entry has unsupported keys {sorted(extra)}:"
+                    f" only per-group 'lr' is supported (betas/momentum are "
+                    f"global)")
+        pats = [re.compile(d["params"]) for d in defs]
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+        def gid(path):
+            s = jax.tree_util.keystr(path)
+            for i, pat in enumerate(pats):
+                if pat.search(s):
+                    return i + 1
+            return 0
+
+        paths = [jax.tree_util.keystr(p) for p, _ in flat]
+        for d, pat in zip(defs, pats):
+            # a pattern that matches NOTHING is a typo, not a choice
+            # (a pattern fully shadowed by an earlier group is allowed —
+            # first match wins, like torch group order)
+            if not any(pat.search(s) for s in paths):
+                raise DeepSpeedConfigError(
+                    f"param_groups pattern {d['params']!r} matches no "
+                    f"parameter leaf (patterns are searched against pytree "
+                    f"paths like {paths[0]!r})")
+        ids = treedef.unflatten([gid(p) for p, _ in flat])
+        return [{}] + [dict(d) for d in defs], ids
 
     def _resolve_sparse_flags(self, model, params):
         """Which leaves take the row-sparse gradient reduction.  The
@@ -898,8 +965,16 @@ class DeepSpeedTpuEngine:
         cdt = self.policy.compute_dtype
         meta = self.flat_meta
         sparse_flags = self._sparse_flags
+        group_ids = self._group_ids
+        multi_group = len(self._group_defs) > 1
 
         def step_local(master, opt_state, grads, ls_state, lr, b1, b2, normw):
+            # lr arrives as a [G] vector (one per param group); expand to a
+            # per-leaf tree when groups exist, else the plain scalar
+            if zero or not multi_group:
+                lr = lr[0]
+            else:
+                lr = jax.tree_util.tree_map(lambda gid: lr[gid], group_ids)
             if zero:
                 if zero_2d:
                     # [1, part] local blocks of the [mp, local_padded] layout
@@ -1089,10 +1164,14 @@ class DeepSpeedTpuEngine:
                 getattr(self, "sample_count", self.global_steps))
 
     def _current_hypers(self):
-        g = self.optimizer.param_groups[0]
-        b1, b2 = g.get("betas", (self.base_optimizer.beta1,
-                                 self.base_optimizer.beta2))
-        return (jnp.asarray(g["lr"], jnp.float32),
+        """Live hyperparameters from the facade groups: ``lr`` is a [G]
+        vector (one entry per param group — the scheduler may have written
+        different LRs into each); betas come from group 0 (momentum is
+        global, like the reference's FP16 wrapper)."""
+        groups = self.optimizer.param_groups
+        b1, b2 = groups[0].get("betas", (self.base_optimizer.beta1,
+                                         self.base_optimizer.beta2))
+        return (jnp.asarray([g["lr"] for g in groups], jnp.float32),
                 jnp.asarray(b1, jnp.float32),
                 jnp.asarray(b2, jnp.float32))
 

@@ -104,6 +104,16 @@ class Optimizer:
                combined_scale=1.0) -> Tuple[Any, OptimizerState]:
         raise NotImplementedError
 
+    @staticmethod
+    def _lr_leaves(lr, treedef, n):
+        """``lr`` may be a scalar (all leaves share it) or a pytree matching
+        params (per-leaf LRs — the engine's param-group path).  Returns a
+        flat list of per-leaf scalars."""
+        if lr is None or isinstance(lr, (int, float)) or (
+                hasattr(lr, "ndim") and lr.ndim == 0):
+            return [lr] * n
+        return treedef.flatten_up_to(lr)
+
 
 @dataclasses.dataclass(frozen=True)
 class Adam(Optimizer):
@@ -114,13 +124,14 @@ class Adam(Optimizer):
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
                combined_scale=1.0):
-        lr = self.lr if lr is None else lr
         b1 = self.beta1 if beta1 is None else beta1
         b2 = self.beta2 if beta2 is None else beta2
         step = state.step + 1
-        step_size = self._step_size(lr, step.astype(jnp.float32), b1, b2)
 
-        def leaf(p, g, m, v):
+        def leaf(p, g, m, v, lr_leaf):
+            lr_l = self.lr if lr_leaf is None else lr_leaf
+            step_size = self._step_size(lr_l, step.astype(jnp.float32),
+                                        b1, b2)
             if g is None:
                 return p, m, v
             from deepspeed_tpu.ops import pallas_optim as pk
@@ -129,7 +140,7 @@ class Adam(Optimizer):
                     p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
                     weight_decay=self.weight_decay,
                     combined_scale=combined_scale, step_size=step_size,
-                    lr=lr, eps_inside_sqrt=self.eps_inside_sqrt,
+                    lr=lr_l, eps_inside_sqrt=self.eps_inside_sqrt,
                     decoupled_decay=self.decoupled_decay,
                     interpret=not pk.pallas_available())
             m_new, v_new = self._moments(g, m, v, b1, b2, combined_scale)
@@ -138,15 +149,16 @@ class Adam(Optimizer):
                 upd = upd + self.weight_decay * p
             p_new = p - step_size * upd
             if self.weight_decay > 0.0 and self.decoupled_decay:
-                p_new = p_new - lr * self.weight_decay * p
+                p_new = p_new - lr_l * self.weight_decay * p
             return p_new, m_new, v_new
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state.m)
         flat_v = treedef.flatten_up_to(state.v)
-        out = [leaf(p, g, m, v) for p, g, m, v in
-               zip(flat_p, flat_g, flat_m, flat_v)]
+        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+        out = [leaf(p, g, m, v, l) for p, g, m, v, l in
+               zip(flat_p, flat_g, flat_m, flat_v, flat_lr)]
         new_p = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
@@ -169,13 +181,14 @@ class Lamb(Optimizer):
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
                combined_scale=1.0):
-        lr = self.lr if lr is None else lr
         b1 = self.beta1 if beta1 is None else beta1
         b2 = self.beta2 if beta2 is None else beta2
         step = state.step + 1
-        step_size = self._step_size(lr, step.astype(jnp.float32), b1, b2)
 
-        def leaf(p, g, m, v):
+        def leaf(p, g, m, v, lr_leaf):
+            lr_l = self.lr if lr_leaf is None else lr_leaf
+            step_size = self._step_size(lr_l, step.astype(jnp.float32),
+                                        b1, b2)
             if g is None:
                 return p, m, v
             from deepspeed_tpu.ops import pallas_optim as pk
@@ -204,8 +217,9 @@ class Lamb(Optimizer):
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state.m)
         flat_v = treedef.flatten_up_to(state.v)
-        out = [leaf(p, g, m, v) for p, g, m, v in
-               zip(flat_p, flat_g, flat_m, flat_v)]
+        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+        out = [leaf(p, g, m, v, l) for p, g, m, v, l in
+               zip(flat_p, flat_g, flat_m, flat_v, flat_lr)]
         new_p = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
@@ -224,37 +238,40 @@ class Sgd(Optimizer):
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
                combined_scale=1.0):
-        lr = self.lr if lr is None else lr
         step = state.step + 1
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
 
         if self.momentum > 0.0:
-            def leaf(p, g, m):
+            def leaf(p, g, m, lr_leaf):
                 if g is None:
                     return p, m
+                lr_l = self.lr if lr_leaf is None else lr_leaf
                 sg = g.astype(jnp.float32) / combined_scale
                 if self.weight_decay > 0.0:
                     sg = sg + self.weight_decay * p
                 m_new = self.momentum * m + sg
-                return p - lr * m_new, m_new
-            flat_p, treedef = jax.tree_util.tree_flatten(params)
-            flat_g = treedef.flatten_up_to(grads)
+                return p - lr_l * m_new, m_new
             flat_m = treedef.flatten_up_to(state.m)
-            out = [leaf(p, g, m) for p, g, m in zip(flat_p, flat_g, flat_m)]
+            out = [leaf(p, g, m, l) for p, g, m, l in
+                   zip(flat_p, flat_g, flat_m, flat_lr)]
             return (treedef.unflatten([o[0] for o in out]),
                     OptimizerState(step=step,
                                    m=treedef.unflatten([o[1] for o in out]),
                                    v=None))
 
-        def leaf(p, g):
+        def leaf(p, g, lr_leaf):
             if g is None:
                 return p
+            lr_l = self.lr if lr_leaf is None else lr_leaf
             sg = g.astype(jnp.float32) / combined_scale
             if self.weight_decay > 0.0:
                 sg = sg + self.weight_decay * p
-            return p - lr * sg
+            return p - lr_l * sg
 
-        new_p = jax.tree_util.tree_map(leaf, params, grads,
-                                       is_leaf=lambda x: x is None)
+        new_p = treedef.unflatten(
+            [leaf(p, g, l) for p, g, l in zip(flat_p, flat_g, flat_lr)])
         return new_p, OptimizerState(step=step, m=None, v=None)
 
 
