@@ -183,6 +183,22 @@ class DeepSpeedConfig:
         self.tensorboard_output_path = self.tensorboard.output_path
         self.tensorboard_job_name = self.tensorboard.job_name
 
+        # jax.profiler trace window (TPU tracing analog of
+        # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)
+        prof = pd.get(C.PROFILE, None) or {}
+        self.profile_enabled = bool(prof.get(C.PROFILE_ENABLED,
+                                             C.PROFILE_ENABLED_DEFAULT))
+        self.profile_start_step = int(prof.get(C.PROFILE_START_STEP,
+                                               C.PROFILE_START_STEP_DEFAULT))
+        self.profile_end_step = int(prof.get(C.PROFILE_END_STEP,
+                                             C.PROFILE_END_STEP_DEFAULT))
+        self.profile_output_path = str(prof.get(
+            C.PROFILE_OUTPUT_PATH, C.PROFILE_OUTPUT_PATH_DEFAULT))
+        if self.profile_enabled and \
+                self.profile_end_step <= self.profile_start_step:
+            raise DeepSpeedConfigError(
+                "profile.end_step must be greater than profile.start_step")
+
         self.model_parallel_size = get_scalar_param(
             pd, C.MODEL_PARALLEL_SIZE, C.MODEL_PARALLEL_SIZE_DEFAULT)
         self.context_parallel_size = get_scalar_param(

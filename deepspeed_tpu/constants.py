@@ -145,6 +145,20 @@ ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 ACTIVATION_CHECKPOINTING_DEFAULT = None
 
 #############################################
+# Profiler (TPU-native: jax.profiler trace over a step window — the
+# tracing analog of wall_clock_breakdown, SURVEY §5 row 1)
+#############################################
+PROFILE = "profile"
+PROFILE_ENABLED = "enabled"
+PROFILE_ENABLED_DEFAULT = False
+PROFILE_START_STEP = "start_step"
+PROFILE_START_STEP_DEFAULT = 10
+PROFILE_END_STEP = "end_step"
+PROFILE_END_STEP_DEFAULT = 12
+PROFILE_OUTPUT_PATH = "output_path"
+PROFILE_OUTPUT_PATH_DEFAULT = "/tmp/dstpu_profile"
+
+#############################################
 # TensorBoard (reference deepspeed_constants.py:225-245)
 #############################################
 TENSORBOARD = "tensorboard"

@@ -16,6 +16,8 @@ with a leading sample axis.
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from typing import Any, Callable, Iterator, Optional
 
 import jax
@@ -63,7 +65,9 @@ class DeepSpeedDataLoader:
                  tput_timer=None,
                  seed: int = 0,
                  drop_last: bool = True,
-                 local_rank: int = -1):
+                 local_rank: int = -1,
+                 num_workers: int = 0,
+                 prefetch_depth: int = 2):
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.mesh = mesh
@@ -74,6 +78,13 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.epoch = 0
         self.local_rank = local_rank
+        # num_workers > 0 enables background prefetch (the reference defaults
+        # to 2 x device_count torch DataLoader workers,
+        # deepspeed_dataloader.py:33-34; here one producer thread overlaps
+        # collation — itself multithreaded in C for array datasets — with
+        # device compute, queue depth = prefetch_depth)
+        self.num_workers = int(num_workers)
+        self.prefetch_depth = max(1, int(prefetch_depth))
 
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
@@ -112,15 +123,70 @@ class DeepSpeedDataLoader:
     def __len__(self) -> int:
         return self.len
 
+    def _make_batch(self, sel: np.ndarray):
+        """Collate one batch: array datasets gather rows through the native
+        multithreaded memcpy kernel; generic datasets take the per-sample
+        python path."""
+        gather = getattr(self.dataset, "gather", None)
+        if gather is not None and self.collate_fn is default_collate:
+            return gather(sel)
+        samples = [self.dataset[int(i)] for i in sel]
+        return self.collate_fn(samples)
+
+    def _batches(self, idx: np.ndarray):
+        for b in range(self.len):
+            yield self._make_batch(idx[b * self.batch_size:
+                                       (b + 1) * self.batch_size])
+
+    def _prefetched(self, idx: np.ndarray):
+        """Producer thread keeps up to ``prefetch_depth`` collated batches
+        ready while the device computes (torch DataLoader worker analog).
+        Abandoning the iterator early (break / GC) signals the producer to
+        exit instead of leaving it blocked on a full queue."""
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+        SENTINEL = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self._batches(idx):
+                    if not put(batch):
+                        return
+                put(SENTINEL)
+            except BaseException as e:  # surface in the consumer
+                put(e)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="dstpu-io-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join()
+
     def __iter__(self) -> Iterator[Any]:
         idx = self._indices()
-        nb = self.len
-        for b in range(nb):
+        source = (self._prefetched(idx) if self.num_workers > 0
+                  else self._batches(idx))
+        for batch in source:
             if self.tput_timer is not None:
                 self.tput_timer.start()
-            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
-            samples = [self.dataset[int(i)] for i in sel]
-            batch = self.collate_fn(samples)
             yield self._place(batch)
         self.epoch += 1
 
@@ -128,10 +194,11 @@ class DeepSpeedDataLoader:
 class ArrayDataset:
     """Adapter: a pytree of arrays with leading sample axis -> indexable
     dataset (the reference tests build tensor datasets the same way,
-    tests/unit/simple_model.py:44-52)."""
+    tests/unit/simple_model.py:44-52).  Batch collation goes through the
+    native row-gather kernel (``deepspeed_tpu.native``) when available."""
 
     def __init__(self, *arrays):
-        self.arrays = [np.asarray(a) for a in arrays]
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
         n = len(self.arrays[0])
         if any(len(a) != n for a in self.arrays):
             raise ValueError("all arrays must share the leading dimension")
@@ -142,4 +209,10 @@ class ArrayDataset:
 
     def __getitem__(self, i):
         out = tuple(a[i] for a in self.arrays)
+        return out if len(out) > 1 else out[0]
+
+    def gather(self, indices):
+        """Collated batch for an index vector (the DataLoader fast path)."""
+        from deepspeed_tpu import native
+        out = tuple(native.gather_rows(a, indices) for a in self.arrays)
         return out if len(out) > 1 else out[0]

@@ -361,9 +361,10 @@ class DeepSpeedTpuEngine:
         self._acc = None            # accumulated local grads ([dp, ...] tree)
         self._cached_grads = None   # grads from the last forward
         self._last_loss = None
+        self._profiling = False
 
         if self.config.dump_state:
-            self.config.print("DeepSpeedTpuEngine config")
+            self.dump_state()
 
     # ------------------------------------------------------------------ setup
 
@@ -666,10 +667,15 @@ class DeepSpeedTpuEngine:
     def deepspeed_io(self, dataset, batch_size=None, route=C.ROUTE_TRAIN,
                      collate_fn=None, num_local_io_workers=None,
                      data_sampler=None):
-        """DataLoader factory (reference deepspeed_light.py:535-567)."""
+        """DataLoader factory (reference deepspeed_light.py:535-567).
+        ``num_local_io_workers`` > 0 enables background batch prefetch
+        (default: on for the train route, matching the reference's
+        2 x device_count worker default)."""
         if batch_size is None:
             batch_size = (self.train_micro_batch_size_per_gpu()
                           * self.dp_world_size)
+        if num_local_io_workers is None:
+            num_local_io_workers = 1 if route == C.ROUTE_TRAIN else 0
         return DeepSpeedDataLoader(
             dataset,
             batch_size=batch_size,
@@ -677,7 +683,8 @@ class DeepSpeedTpuEngine:
             route=route,
             collate_fn=collate_fn or self.collate_fn,
             tput_timer=self.tput_timer if route == C.ROUTE_TRAIN else None,
-            seed=self.seed)
+            seed=self.seed,
+            num_workers=int(num_local_io_workers))
 
     # --------------------------------------------------------------- forward
 
@@ -1141,10 +1148,65 @@ class DeepSpeedTpuEngine:
                   else (0, 1, 2, 3))
         return jax.jit(fn, donate_argnums=donate)
 
+    def dump_state(self):
+        """Config + engine-state + memory dump (reference dump_state,
+        deepspeed_light.py:183-185 + deepspeed_config.py:373-385)."""
+        self.config.print("DeepSpeedTpuEngine config")
+        logger.info(
+            "engine state: mesh=%s (dp=%d mp=%d sp=%d) zero=%s "
+            "compute_dtype=%s optimizer=%s groups=%d",
+            dict(self.mesh.shape), self.dp_world_size, self.mp_world_size,
+            self.sp_world_size, self.zero_enabled,
+            jnp.dtype(self.policy.compute_dtype).name,
+            self.base_optimizer.name, len(self._group_defs))
+        logger.info("steps: global=%d micro=%d skipped=%d",
+                    self.global_steps, self.micro_steps, self.skipped_steps)
+        mem = SynchronizedWallClockTimer.memory_usage()
+        if mem:
+            logger.info("memory: %s", mem)
+
+    # ------------------------------------------------------------- profiling
+
+    def start_profile(self, output_path: Optional[str] = None):
+        """Start a jax.profiler trace (TensorBoard/Perfetto-viewable) — the
+        TPU tracing analog of the reference's wall_clock_breakdown spans
+        (SURVEY §5).  Also driven automatically by the ``profile`` config
+        section over a [start_step, end_step) window."""
+        if self._profiling:
+            return
+        path = output_path or self.config.profile_output_path
+        jax.profiler.start_trace(path)
+        self._profiling = True
+        # flush the trace even if training ends inside the window
+        import atexit
+        atexit.register(self.stop_profile)
+        logger.info("jax.profiler trace started -> %s", path)
+
+    def stop_profile(self):
+        if not self._profiling:
+            return
+        jax.profiler.stop_trace()
+        self._profiling = False
+        logger.info("jax.profiler trace stopped")
+
+    def _profile_window(self):
+        cfg = self.config
+        if not cfg.profile_enabled:
+            return
+        # range (not equality) checks: a checkpoint resume can land past
+        # start_step and must still trace the remainder of the window
+        if (not self._profiling
+                and cfg.profile_start_step <= self.global_steps
+                < cfg.profile_end_step):
+            self.start_profile()
+        elif self._profiling and self.global_steps >= cfg.profile_end_step:
+            self.stop_profile()
+
     def _post_boundary_bookkeeping(self, overflow):
         """Counters, overflow-aware LR step, progress + TB reporting after a
         boundary update (reference deepspeed_light.py:723-788)."""
         self.global_steps += 1
+        self._profile_window()
         if self.config.fp16_enabled:
             self.overflow = bool(overflow)   # host sync, boundary-only
         else:

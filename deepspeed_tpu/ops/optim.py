@@ -275,6 +275,92 @@ class Sgd(Optimizer):
         return new_p, OptimizerState(step=step, m=None, v=None)
 
 
+@dataclasses.dataclass(frozen=True)
+class RMSprop(Optimizer):
+    """torch.optim.RMSprop equivalent (no momentum/centered variants):
+    ``v = alpha*v + (1-alpha)*g^2; p -= lr * g / (sqrt(v) + eps)``."""
+    name: str = "rmsprop"
+    alpha: float = 0.99
+    eps: float = 1e-8
+
+    def init(self, params) -> OptimizerState:
+        return OptimizerState(step=jnp.zeros((), jnp.int32), m=None,
+                              v=_zeros_like_tree(params))
+
+    def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
+               combined_scale=1.0):
+        step = state.step + 1
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_v = treedef.flatten_up_to(state.v)
+        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+
+        def leaf(p, g, v, lr_leaf):
+            if g is None:
+                return p, v
+            lr_l = self.lr if lr_leaf is None else lr_leaf
+            sg = g.astype(jnp.float32) / combined_scale
+            if self.weight_decay > 0.0:
+                sg = sg + self.weight_decay * p
+            v_new = self.alpha * v + (1.0 - self.alpha) * sg * sg
+            return p - lr_l * sg / (jnp.sqrt(v_new) + self.eps), v_new
+
+        out = [leaf(p, g, v, l) for p, g, v, l in
+               zip(flat_p, flat_g, flat_v, flat_lr)]
+        return (treedef.unflatten([o[0] for o in out]),
+                OptimizerState(step=step, m=None,
+                               v=treedef.unflatten([o[1] for o in out])))
+
+
+@dataclasses.dataclass(frozen=True)
+class Adagrad(Optimizer):
+    """torch.optim.Adagrad equivalent:
+    ``v += g^2; p -= lr * g / (sqrt(v) + eps)``."""
+    name: str = "adagrad"
+    eps: float = 1e-10
+
+    def init(self, params) -> OptimizerState:
+        return OptimizerState(step=jnp.zeros((), jnp.int32), m=None,
+                              v=_zeros_like_tree(params))
+
+    def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
+               combined_scale=1.0):
+        step = state.step + 1
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_v = treedef.flatten_up_to(state.v)
+        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+
+        def leaf(p, g, v, lr_leaf):
+            if g is None:
+                return p, v
+            lr_l = self.lr if lr_leaf is None else lr_leaf
+            sg = g.astype(jnp.float32) / combined_scale
+            if self.weight_decay > 0.0:
+                sg = sg + self.weight_decay * p
+            v_new = v + sg * sg
+            return p - lr_l * sg / (jnp.sqrt(v_new) + self.eps), v_new
+
+        out = [leaf(p, g, v, l) for p, g, v, l in
+               zip(flat_p, flat_g, flat_v, flat_lr)]
+        return (treedef.unflatten([o[0] for o in out]),
+                OptimizerState(step=step, m=None,
+                               v=treedef.unflatten([o[1] for o in out])))
+
+
+# --------------------------------------------------------------- extension
+# The reference falls through to torch.optim.<name> for any optimizer it
+# doesn't wrap (deepspeed_light.py:479-481); functional pytree optimizers
+# have no torch registry to borrow, so third parties register factories here.
+_REGISTRY: dict = {}
+
+
+def register_optimizer(name: str, factory) -> None:
+    """Register ``factory(**params_dict) -> Optimizer`` under a config
+    ``optimizer.type`` name (case-insensitive)."""
+    _REGISTRY[name.lower()] = factory
+
+
 def from_config(name: str, params_dict: Optional[dict] = None) -> Optimizer:
     """Instantiate by config name (reference _configure_basic_optimizer,
     deepspeed_light.py:466-481).  Accepted params follow torch/apex spellings:
@@ -313,4 +399,18 @@ def from_config(name: str, params_dict: Optional[dict] = None) -> Optimizer:
         if "momentum" in p:
             kw["momentum"] = float(p.pop("momentum"))
         return Sgd(**kw)
+    if name_l == "rmsprop":
+        if "alpha" in p:
+            kw["alpha"] = float(p.pop("alpha"))
+        if float(p.pop("momentum", 0) or 0) or p.pop("centered", False):
+            raise ValueError(
+                "RMSprop momentum/centered variants are not implemented — "
+                "refusing to silently train with different dynamics")
+        return RMSprop(**kw)
+    if name_l == "adagrad":
+        if float(p.pop("lr_decay", 0) or 0):
+            raise ValueError("Adagrad lr_decay is not implemented")
+        return Adagrad(**kw)
+    if name_l in _REGISTRY:
+        return _REGISTRY[name_l](**dict(params_dict or {}))
     raise ValueError(f"Unknown optimizer {name!r}")

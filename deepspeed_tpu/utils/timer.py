@@ -79,10 +79,24 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage() -> str:
-        if not PSUTIL_AVAILABLE:
-            return ""
-        vm = psutil.virtual_memory()
-        return f"host mem used {vm.used / 2**30:.2f} GB ({vm.percent}%)"
+        """Device HBM (live + peak, from the PJRT allocator) + host memory —
+        the reference's see_memory_usage analog
+        (zero_optimizer.py:320-332 reports torch.cuda memory_allocated)."""
+        parts = []
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            if "bytes_in_use" in stats:
+                s = f"device mem {stats['bytes_in_use'] / 2**30:.2f} GB"
+                if "peak_bytes_in_use" in stats:
+                    s += f" (peak {stats['peak_bytes_in_use'] / 2**30:.2f})"
+                parts.append(s)
+        except Exception:  # backends without memory_stats (CPU)
+            pass
+        if PSUTIL_AVAILABLE:
+            vm = psutil.virtual_memory()
+            parts.append(
+                f"host mem used {vm.used / 2**30:.2f} GB ({vm.percent}%)")
+        return " | ".join(parts)
 
     def log(self, names, normalizer: float = 1.0, reset: bool = True,
             memory_breakdown: bool = False):

@@ -147,7 +147,7 @@ def test_from_config():
     o = ops.from_config("sgd", {"lr": 0.1, "momentum": 0.9})
     assert isinstance(o, ops.Sgd) and o.momentum == 0.9
     with pytest.raises(ValueError):
-        ops.from_config("adagrad", {})
+        ops.from_config("nonexistent_optimizer", {})
 
 
 def test_update_is_jittable():
