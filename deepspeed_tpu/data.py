@@ -124,10 +124,12 @@ class DeepSpeedDataLoader:
         return self.len
 
     def _make_batch(self, sel: np.ndarray):
-        """Collate one batch: array datasets gather rows through the native
-        multithreaded memcpy kernel; generic datasets take the per-sample
-        python path."""
-        gather = getattr(self.dataset, "gather", None)
+        """Collate one batch: datasets exposing the ``collate_gather``
+        protocol (ArrayDataset) gather rows through the native multithreaded
+        memcpy kernel; generic datasets take the per-sample python path.
+        The distinct protocol name avoids hijacking unrelated ``gather``
+        methods (e.g. torch.Tensor.gather)."""
+        gather = getattr(self.dataset, "collate_gather", None)
         if gather is not None and self.collate_fn is default_collate:
             return gather(sel)
         samples = [self.dataset[int(i)] for i in sel]
@@ -182,12 +184,22 @@ class DeepSpeedDataLoader:
 
     def __iter__(self) -> Iterator[Any]:
         idx = self._indices()
-        source = (self._prefetched(idx) if self.num_workers > 0
-                  else self._batches(idx))
-        for batch in source:
-            if self.tput_timer is not None:
-                self.tput_timer.start()
-            yield self._place(batch)
+        if self.num_workers > 0:
+            # collation runs concurrently on the producer; the timed span
+            # covers dequeue + device placement
+            for batch in self._prefetched(idx):
+                if self.tput_timer is not None:
+                    self.tput_timer.start()
+                yield self._place(batch)
+        else:
+            # synchronous path: collation stays inside the timed span, like
+            # the reference hooking the timer in __next__
+            for b in range(self.len):
+                if self.tput_timer is not None:
+                    self.tput_timer.start()
+                batch = self._make_batch(idx[b * self.batch_size:
+                                             (b + 1) * self.batch_size])
+                yield self._place(batch)
         self.epoch += 1
 
 
@@ -211,7 +223,7 @@ class ArrayDataset:
         out = tuple(a[i] for a in self.arrays)
         return out if len(out) > 1 else out[0]
 
-    def gather(self, indices):
+    def collate_gather(self, indices):
         """Collated batch for an index vector (the DataLoader fast path)."""
         from deepspeed_tpu import native
         out = tuple(native.gather_rows(a, indices) for a in self.arrays)

@@ -1177,9 +1177,13 @@ class DeepSpeedTpuEngine:
         path = output_path or self.config.profile_output_path
         jax.profiler.start_trace(path)
         self._profiling = True
-        # flush the trace even if training ends inside the window
-        import atexit
-        atexit.register(self.stop_profile)
+        # flush the trace even if training ends inside the window; register
+        # exactly once (a bound-method atexit handler pins the engine — one
+        # is tolerable, one per start/stop cycle is a leak)
+        if not getattr(self, "_profile_atexit", False):
+            import atexit
+            atexit.register(self.stop_profile)
+            self._profile_atexit = True
         logger.info("jax.profiler trace started -> %s", path)
 
     def stop_profile(self):

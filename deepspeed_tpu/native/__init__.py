@@ -40,8 +40,8 @@ def _load():
     if _LOAD_TRIED:
         return _LIB
     _LOAD_TRIED = True
-    so = _so_path()
     try:
+        so = _so_path()   # inside try: collate.c may be absent (zip install)
         if not os.path.exists(so):
             cc = os.environ.get("CC", "cc")
             tmp = so + f".build{os.getpid()}"
