@@ -1,0 +1,22 @@
+# Shared config loading for the TPU-VM fleet scripts (the azure/ analog of
+# the reference; GCP TPU VMs instead of Azure GPU VMs).  Requires gcloud + jq.
+set -euo pipefail
+
+CONFIG_FILE=${CONFIG_FILE:-"$(dirname "$0")/tpu_config.json"}
+if [ ! -f "${CONFIG_FILE}" ]; then
+    echo "Cannot find ${CONFIG_FILE}" >&2
+    exit 1
+fi
+command -v jq >/dev/null || { echo "jq is required" >&2; exit 1; }
+command -v gcloud >/dev/null || { echo "gcloud is required" >&2; exit 1; }
+
+cfg() { jq -r "$1" "${CONFIG_FILE}"; }
+
+PROJECT=$(cfg .project)
+ZONE=$(cfg .zone)
+TPU_NAME=$(cfg .tpu_name)
+ACCEL=$(cfg .accelerator_type)
+RUNTIME=$(cfg .runtime_version)
+
+GC="gcloud compute tpus tpu-vm"
+GFLAGS=(--project "${PROJECT}" --zone "${ZONE}")

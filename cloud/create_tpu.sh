@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Create the TPU-VM slice described in tpu_config.json
+# (reference analog: azure/create_vms.sh).
+source "$(dirname "$0")/common.sh"
+
+${GC} create "${TPU_NAME}" "${GFLAGS[@]}" \
+    --accelerator-type "${ACCEL}" \
+    --version "${RUNTIME}"
+
+echo "created ${TPU_NAME} (${ACCEL}) in ${ZONE}"
+${GC} describe "${TPU_NAME}" "${GFLAGS[@]}" --format='value(state)'

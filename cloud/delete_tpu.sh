@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Tear the slice down (reference analog: azure/shutdown_vms.sh).
+source "$(dirname "$0")/common.sh"
+
+${GC} delete "${TPU_NAME}" "${GFLAGS[@]}" --quiet
+echo "deleted ${TPU_NAME}"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Emit a launcher hostfile ("<ip> slots=1" per worker host — one process per
+# host is the TPU contract, see docs/gpt2-tutorial.md) from the slice's
+# internal IPs, for use with bin/dst --hostfile.
+source "$(dirname "$0")/common.sh"
+
+${GC} describe "${TPU_NAME}" "${GFLAGS[@]}" \
+    --format='value(networkEndpoints[].ipAddress)' |
+    tr ';' '\n' | while read -r ip; do
+        [ -n "${ip}" ] && echo "${ip} slots=1"
+    done

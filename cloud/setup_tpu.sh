@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Install deepspeed_tpu on every worker of the slice
+# (reference analog: azure/setup_vms.sh + install.sh pdsh deploy).
+source "$(dirname "$0")/common.sh"
+
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+
+# build the wheel locally, push + install on all workers
+(cd "${REPO_DIR}" && ./install.sh --skip-build 2>/dev/null || true)
+(cd "${REPO_DIR}" && python -m pip wheel --no-deps --no-build-isolation \
+    -w dist . >/dev/null)
+WHEEL=$(ls "${REPO_DIR}"/dist/deepspeed_tpu-*.whl | head -1)
+
+${GC} scp "${WHEEL}" "${TPU_NAME}:/tmp/" "${GFLAGS[@]}" --worker=all
+${GC} ssh "${TPU_NAME}" "${GFLAGS[@]}" --worker=all --command "
+    pip install -q 'jax[tpu]' \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html &&
+    pip install -q --force-reinstall /tmp/$(basename "${WHEEL}")"
+
+echo "installed $(basename "${WHEEL}") on all workers of ${TPU_NAME}"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Run a command on one worker (or interactively ssh in)
+# (reference analog: azure/azure_ssh.sh).
+#   ./tpu_ssh.sh <worker-id> [command...]
+source "$(dirname "$0")/common.sh"
+
+WORKER=${1:-0}
+shift || true
+
+if [ $# -eq 0 ]; then
+    exec ${GC} ssh "${TPU_NAME}" "${GFLAGS[@]}" --worker="${WORKER}"
+fi
+exec ${GC} ssh "${TPU_NAME}" "${GFLAGS[@]}" --worker="${WORKER}" \
+    --command "$*"
