@@ -10,7 +10,14 @@ fi
 command -v jq >/dev/null || { echo "jq is required" >&2; exit 1; }
 command -v gcloud >/dev/null || { echo "gcloud is required" >&2; exit 1; }
 
-cfg() { jq -r "$1" "${CONFIG_FILE}"; }
+cfg() {
+    local v
+    v=$(jq -er "$1 // empty" "${CONFIG_FILE}") && [ -n "${v}" ] || {
+        echo "missing/empty key $1 in ${CONFIG_FILE}" >&2
+        exit 1
+    }
+    echo "${v}"
+}
 
 PROJECT=$(cfg .project)
 ZONE=$(cfg .zone)

@@ -5,10 +5,10 @@ source "$(dirname "$0")/common.sh"
 
 REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 
-# build the wheel locally, push + install on all workers
-(cd "${REPO_DIR}" && ./install.sh --skip-build 2>/dev/null || true)
-(cd "${REPO_DIR}" && python -m pip wheel --no-deps --no-build-isolation \
-    -w dist . >/dev/null)
+# build a FRESH wheel (a stale dist/ could deploy outdated code), then
+# push + install on all workers
+(cd "${REPO_DIR}" && rm -rf dist/ && \
+    python -m pip wheel --no-deps --no-build-isolation -w dist . >/dev/null)
 WHEEL=$(ls "${REPO_DIR}"/dist/deepspeed_tpu-*.whl | head -1)
 
 ${GC} scp "${WHEEL}" "${TPU_NAME}:/tmp/" "${GFLAGS[@]}" --worker=all
