@@ -7,7 +7,7 @@ REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 
 # build a FRESH wheel (a stale dist/ could deploy outdated code), then
 # push + install on all workers
-(cd "${REPO_DIR}" && rm -rf dist/ && \
+(cd "${REPO_DIR}" && rm -rf dist/ build/ deepspeed_tpu.egg-info/ && \
     python -m pip wheel --no-deps --no-build-isolation -w dist . >/dev/null)
 WHEEL=$(ls "${REPO_DIR}"/dist/deepspeed_tpu-*.whl | head -1)
 

@@ -1,0 +1,102 @@
+"""Context parallelism composed with the rest of the engine: sp x tp,
+sp x fused train_batch, and sp x ZeRO — the combinations the per-feature
+tests don't cross (the driver's dryrun runs tp x sp x dp once; these pin the
+numerics).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.parallel.topology import make_mesh
+
+VOCAB, SEQ = 64, 16
+
+
+def make_engine(sp=1, mp=1, zero=False, seed=7, **cfg_over):
+    cfg = {
+        "train_batch_size": 4,
+        "steps_per_print": 10 ** 6,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    if zero:
+        cfg["zero_optimization"] = True
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    cfg.update(cfg_over)
+    model = GPT2.from_size("tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                           num_layers=2, hidden_size=32, num_heads=4)
+    n = 4 * sp * mp
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(seed)),
+        mesh=make_mesh(context_parallel_size=sp, model_parallel_size=mp,
+                       devices=jax.devices()[:min(n, 8)]))
+    return engine
+
+
+def batches(steps):
+    out = []
+    for i in range(steps):
+        rng = np.random.default_rng(i)
+        toks = rng.integers(0, VOCAB, size=(4, SEQ)).astype(np.int32)
+        labels = np.roll(toks, -1, axis=1)
+        out.append((toks, labels))
+    return out
+
+
+def run_split(engine, data):
+    losses = []
+    for toks, labels in data:
+        loss = engine(toks, labels)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_sp_with_tensor_parallel():
+    """sp=2 x mp=2 must reproduce the sp=1 x mp=1 trajectory (fp32)."""
+    data = batches(4)
+    ref = run_split(make_engine(sp=1, mp=1), data)
+    got = run_split(make_engine(sp=2, mp=2), data)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sp_fused_train_batch():
+    """The fused train_batch program agrees with the split API under sp=2."""
+    data = batches(4)
+    e1 = make_engine(sp=2)
+    e2 = make_engine(sp=2)
+    split = run_split(e1, data)
+    fused = [float(e2.train_batch(b)) for b in data]
+    np.testing.assert_allclose(fused, split, rtol=2e-5, atol=2e-6)
+
+
+def test_sp_with_zero():
+    """ZeRO partitioning under a sequence ring matches the sp=1 ZeRO run
+    (fp16)."""
+    data = batches(5)
+    ref = run_split(make_engine(sp=1, zero=True), data)
+    got = run_split(make_engine(sp=2, zero=True), data)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-3)
+
+
+def test_sp_gas_scan():
+    """Grad accumulation (lax.scan over micro-batches) under sp=2: fused
+    path vs gas=1 equivalence on the summed batch."""
+    data = batches(2)
+    big = (np.concatenate([d[0] for d in data]),
+           np.concatenate([d[1] for d in data]))
+    e1 = make_engine(sp=2, train_batch_size=8,
+                     gradient_accumulation_steps=2)
+    e2 = make_engine(sp=2, train_batch_size=8)
+    l1 = float(e1.train_batch(big))
+    l2 = float(e2.train_batch(big))
+    # same effective batch, same summed grads => same first update
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(e1.master)[0]),
+        np.asarray(jax.tree_util.tree_leaves(e2.master)[0]),
+        rtol=1e-5, atol=1e-6)
+    assert np.isfinite(l1) and np.isfinite(l2)
