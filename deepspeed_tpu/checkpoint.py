@@ -167,6 +167,10 @@ def _combine_mp_states(local_trees, specs):
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None) -> str:
     """Engine-level save (reference save_checkpoint :1048-1114)."""
+    if getattr(engine, "pp_world_size", 1) > 1:
+        raise NotImplementedError(
+            "checkpointing with pipeline_parallel_size > 1 is not supported "
+            "yet: pipe-sharded layer stacks need per-stage files")
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)

@@ -113,6 +113,8 @@ class DeepSpeedConfig:
             pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(
             pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.pipeline_parallel_size = get_scalar_param(
+            pd, C.PIPELINE_PARALLEL_SIZE, C.PIPELINE_PARALLEL_SIZE_DEFAULT)
         self.sparse_gradients_max_rows = get_scalar_param(
             pd, C.SPARSE_GRADIENTS_MAX_ROWS,
             C.SPARSE_GRADIENTS_MAX_ROWS_DEFAULT)

@@ -186,6 +186,9 @@ MODEL_PARALLEL_SIZE_DEFAULT = 1
 MESH_SEQ_AXIS = "seq"
 CONTEXT_PARALLEL_SIZE = "context_parallel_size"
 CONTEXT_PARALLEL_SIZE_DEFAULT = 1
+MESH_PIPE_AXIS = "pipe"
+PIPELINE_PARALLEL_SIZE = "pipeline_parallel_size"
+PIPELINE_PARALLEL_SIZE_DEFAULT = 1
 
 ZERO_PARAMETER_PARALLEL_SIZE = "parameter_parallel_size"
 ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT = None

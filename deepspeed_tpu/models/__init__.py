@@ -9,11 +9,13 @@ from deepspeed_tpu.models.transformer import (TransformerConfig,
                                               block_partition_specs,
                                               block_apply, stack_apply)
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
+from deepspeed_tpu.models.pipeline_gpt2 import GPT2Pipelined
 from deepspeed_tpu.models.bert import (BertForPreTraining,
                                        BertForQuestionAnswering, BERT_SIZES)
 
 __all__ = [
     "TransformerConfig", "init_block_params", "block_partition_specs",
     "block_apply", "stack_apply", "GPT2", "GPT2_SIZES",
+    "GPT2Pipelined",
     "BertForPreTraining", "BertForQuestionAnswering", "BERT_SIZES",
 ]

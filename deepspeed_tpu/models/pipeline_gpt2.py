@@ -1,0 +1,79 @@
+"""GPT-2 with pipeline-parallel layer stages (GPipe schedule, ``pipe`` axis).
+
+Beyond-reference model variant (the reference has no pipeline engine): the
+same parameters and math as ``models.gpt2.GPT2``, but the stacked block
+parameters shard their layer dimension over ``pipe`` and the stack executes
+through ``parallel.pipeline.pipeline_apply``.  Embeddings and the final
+LayerNorm/head are replicated across stages; the loss is masked to the last
+stage and psum'd, so stage-replicated parameter gradients arrive as
+per-stage partial sums the engine completes over ``pipe``.
+
+Composes with tensor parallelism (blocks sharded over BOTH pipe and model)
+and data parallelism; ZeRO / context parallelism / checkpointing with pp>1
+are engine-guarded for now.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.models.gpt2 import GPT2
+from deepspeed_tpu.parallel import pipeline as pipe_mod
+from deepspeed_tpu.parallel.topology import PIPE_AXIS
+
+
+@dataclasses.dataclass
+class GPT2Pipelined(GPT2):
+    """``num_micro_batches`` micro-batches stream through the stage ring per
+    forward; the per-shard batch must divide evenly."""
+    num_micro_batches: int = 2
+
+    @classmethod
+    def from_size(cls, size: str, num_micro_batches: int = 2, **overrides):
+        base = GPT2.from_size(size, **overrides)
+        return cls(config=base.config, num_micro_batches=num_micro_batches)
+
+    def partition_specs(self, params=None):
+        specs = super().partition_specs(params)
+        # layer stacks: leading (layer) dim over the pipe axis, everything
+        # else (incl. model-axis TP dims) unchanged
+        specs["blocks"] = {
+            k: P(PIPE_AXIS, *s[1:]) for k, s in specs["blocks"].items()
+        }
+        return specs
+
+    def apply(self, params, tokens, labels):
+        cfg = self.config
+        B, T_len = tokens.shape
+        m = self.num_micro_batches
+        if B % m:
+            raise ValueError(
+                f"per-shard batch {B} not divisible by "
+                f"num_micro_batches={m}")
+        x = L.vocab_parallel_embedding(tokens, params["wte"])
+        x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
+            x.dtype)[None]
+        x_micro = x.reshape(m, B // m, T_len, x.shape[-1])
+
+        def stage_fn(u):
+            # inside shard_map the blocks leaf is this stage's LOCAL
+            # [L/pp, ...] slice; stack_apply scans exactly those layers
+            # (with the configured remat policy)
+            return T.stack_apply(u, params["blocks"], cfg)
+
+        x = pipe_mod.pipeline_apply(x_micro, stage_fn)
+        x = x.reshape(B, T_len, x.shape[-1])
+        x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
+        logits = L.vocab_parallel_logits(x, params["wte"])
+        loss = L.vocab_parallel_cross_entropy(logits, labels)
+        loss = L.masked_mean_loss(loss, labels >= 0)
+        # exactly one stage contributes the loss (and head/embed grads);
+        # the engine completes replicated-leaf grads with a pipe psum
+        return pipe_mod.mask_to_last_stage(jnp.asarray(loss, jnp.float32))
+
+    __call__ = apply

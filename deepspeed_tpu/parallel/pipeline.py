@@ -1,0 +1,87 @@
+"""Pipeline parallelism: a GPipe schedule over the ``pipe`` mesh axis.
+
+Beyond-reference component (the reference v0.1.0 has no pipeline engine —
+SURVEY.md §0 lists it as explicitly absent; this is the TPU-native shape of
+one).  Layer-stacked parameters shard their leading (layer) dimension over
+``pipe`` so each stage owns ``L / pp`` consecutive blocks.  Execution is SPMD:
+every stage runs the same program; micro-batches stream through a
+``lax.scan`` over ``m + pp - 1`` ticks, each tick applying the stage's local
+blocks and handing the activation to the next stage with a ``ppermute``.
+Autodiff through ``ppermute`` (its transpose is the reverse permute) yields
+the exact pipelined backward — the 1F1B-style memory optimisation is left to
+rematerialisation of the stage blocks.
+
+The finished micro-batches exist on the LAST stage; ``collect`` masks other
+stages to zero and ``psum``s over ``pipe``, so downstream (head/loss) math is
+replicated and uniform across stages — gradients of stage-replicated
+parameters then arrive as per-stage partial contributions that the engine
+sums over ``pipe`` (same rule as model-axis-replicated leaves).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.topology import PIPE_AXIS
+
+
+def pipeline_apply(x_micro: jnp.ndarray,
+                   stage_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                   axis: str = PIPE_AXIS) -> jnp.ndarray:
+    """Run the GPipe schedule.
+
+    x_micro:  [m, mb, ...] micro-batched activations, replicated over
+              ``axis`` (every stage holds them; only stage 0 injects).
+    stage_fn: applies THIS stage's local blocks to one [mb, ...] activation.
+
+    Returns [m, mb, ...] outputs, replicated over ``axis`` (psum-collected
+    from the last stage).  Must run inside shard_map over a mesh with
+    ``axis``.
+    """
+    pp = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    m = x_micro.shape[0]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    is_first = (stage == 0)
+    is_last = (stage == pp - 1)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 ingests micro-batch t (clipped re-injections past the end
+        # never reach the last stage within the scan — wasted, not wrong)
+        inject = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        cur = jnp.where(is_first, inject, buf)
+        y = stage_fn(cur)
+        # the last stage's y at tick t is finished micro t - (pp - 1)
+        out_t = t - (pp - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, y.astype(outputs.dtype), jnp.clip(out_t, 0, m - 1),
+            axis=0)
+        outputs = jnp.where(out_t >= 0, updated, outputs)
+        # hand off to the next stage (the wrap edge pp-1 -> 0 carries only
+        # garbage that stage 0 immediately overwrites with its injection)
+        buf = jax.lax.ppermute(y, axis, perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(x_micro[0])
+    out0 = jnp.zeros_like(x_micro)
+    (_, outputs), _ = jax.lax.scan(tick, (buf0, out0),
+                                   jnp.arange(m + pp - 1))
+    # only the last stage holds real outputs; make them uniform
+    outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis)
+
+
+def mask_to_last_stage(value: jnp.ndarray, axis: str = PIPE_AXIS):
+    """Zero ``value`` except on the last stage, then psum — the loss-side
+    collection rule: keeps the loss (and therefore every replicated-leaf
+    gradient) a SUM of per-stage contributions, exactly one of which is
+    nonzero."""
+    pp = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    masked = jnp.where(stage == pp - 1, value, jnp.zeros_like(value))
+    return jax.lax.psum(masked, axis)

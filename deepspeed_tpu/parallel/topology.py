@@ -35,6 +35,7 @@ from jax.sharding import Mesh
 logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
+PIPE_AXIS = "pipe"    # pipeline parallelism (layer stages, GPipe schedule)
 SEQ_AXIS = "seq"      # context/sequence parallelism (ring attention)
 MODEL_AXIS = "model"
 
@@ -44,37 +45,43 @@ _ACTIVE_MESH: Optional[Mesh] = None
 @dataclasses.dataclass
 class MeshConfig:
     """Declarative mesh request: model_parallel_size chips per model replica,
-    context_parallel_size chips per sequence ring, the rest of the slice
+    context_parallel_size chips per sequence ring,
+    pipeline_parallel_size chips per layer pipeline, the rest of the slice
     becomes the data axis."""
     model_parallel_size: int = 1
     context_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
     devices: Optional[Sequence] = None  # default: all visible devices
 
 
 def make_mesh(model_parallel_size: int = 1,
               context_parallel_size: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """Build the global ('data', 'seq', 'model') mesh.
+              devices: Optional[Sequence] = None,
+              pipeline_parallel_size: int = 1) -> Mesh:
+    """Build the global ('data', 'pipe', 'seq', 'model') mesh.
 
     The equivalent of constructing DP/MP process groups (reference
-    deepspeed_light.py:63-77 and the Megatron mpu) plus a context-parallel
-    axis the reference lacks (SURVEY.md §2.3 row 22): devices are laid out
-    [data, seq, model] with model innermost so tensor-parallel collectives
-    ride the fastest ICI links, the sequence ring next (ppermute neighbours
-    adjacent), and DP gradient reductions across the remaining dimension.
+    deepspeed_light.py:63-77 and the Megatron mpu) plus context- and
+    pipeline-parallel axes the reference lacks (SURVEY.md §2.3 row 22):
+    devices are laid out [data, pipe, seq, model] with model innermost so
+    tensor-parallel collectives ride the fastest ICI links, the sequence
+    ring next (ppermute neighbours adjacent), the pipeline ring outside
+    that (stage handoffs are one activation per tick — latency-tolerant),
+    and DP gradient reductions across the remaining dimension.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     mp = int(model_parallel_size)
     sp = int(context_parallel_size)
-    if mp < 1 or sp < 1 or n % (mp * sp) != 0:
+    pp = int(pipeline_parallel_size)
+    if mp < 1 or sp < 1 or pp < 1 or n % (mp * sp * pp) != 0:
         raise ValueError(
-            f"model_parallel_size {mp} x context_parallel_size {sp} must "
-            f"divide device count {n}")
-    dp = n // (mp * sp)
-    arr = np.asarray(devices).reshape(dp, sp, mp)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+            f"model_parallel_size {mp} x context_parallel_size {sp} x "
+            f"pipeline_parallel_size {pp} must divide device count {n}")
+    dp = n // (mp * sp * pp)
+    arr = np.asarray(devices).reshape(dp, pp, sp, mp)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def set_mesh(mesh: Mesh) -> None:
@@ -96,6 +103,10 @@ def model_parallel_size(mesh: Mesh) -> int:
 
 def context_parallel_size(mesh: Mesh) -> int:
     return mesh.shape.get(SEQ_AXIS, 1)
+
+
+def pipeline_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape.get(PIPE_AXIS, 1)
 
 
 # ------------------------------------------------------------------ bootstrap
