@@ -197,7 +197,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     params_mp, owned = _collect_mp_states(engine.params, engine._param_specs,
                                           mp)
     if engine.zero_enabled:
-        master_mp = m_mp = v_mp = [None] * mp   # masters live in ZeRO files
+        # three SEPARATE lists: masters live in ZeRO files, and sharing one
+        # list object would make any future in-place write corrupt all three
+        master_mp, m_mp, v_mp = ([None] * mp for _ in range(3))
         step_np = None
     else:
         master_mp, _ = _collect_mp_states(engine.master, engine._param_specs,

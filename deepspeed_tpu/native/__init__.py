@@ -27,11 +27,33 @@ _LIB = None
 _LOAD_TRIED = False
 
 
+def _cache_dir() -> str:
+    # A world-writable location (/tmp) would let another local user pre-plant
+    # the .so and run code in this process; keep the cache private (0700) and
+    # refuse to load anything we don't own.
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    if not os.path.isabs(base):
+        # tempdir fallback is shared across users: keep per-uid isolation in
+        # the name or the first user's 0700 dir locks everyone else out
+        base = tempfile.gettempdir()
+        d = os.path.join(base, f"deepspeed_tpu_{os.getuid()}")
+    else:
+        d = os.path.join(base, "deepspeed_tpu")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    os.chmod(d, 0o700)
+    return d
+
+
 def _so_path() -> str:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(tempfile.gettempdir(),
-                        f"dstpu_collate_{digest}_{os.getuid()}.so")
+    return os.path.join(_cache_dir(), f"dstpu_collate_{digest}.so")
+
+
+def _owned_by_us(path: str) -> bool:
+    st = os.stat(path)
+    return st.st_uid == os.getuid() and not (st.st_mode & 0o022)
 
 
 def _load():
@@ -48,7 +70,11 @@ def _load():
             subprocess.run(
                 [cc, "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
                 check=True, capture_output=True, timeout=60)
+            os.chmod(tmp, 0o700)
             os.replace(tmp, so)        # atomic vs concurrent builders
+        if not _owned_by_us(so):
+            raise OSError(f"refusing to load {so}: not owned by uid "
+                          f"{os.getuid()} with mode ~go-w")
         lib = ctypes.CDLL(so)
         lib.gather_rows.restype = ctypes.c_int
         lib.gather_rows.argtypes = [

@@ -53,8 +53,11 @@ def _batch_block(B: int, T: int, hb: int, budget: int) -> int:
 
 def supported(seq_len: int, n_heads: int, head_dim: int) -> bool:
     hb = _head_block(n_heads)
+    # gate on the BACKWARD budget (half the forward's): even at bb=1 the
+    # backward keeps p/dP/dS score tiles live, so a shape that only fits the
+    # forward would exhaust VMEM on the grad pass
     return (seq_len % 8 == 0 and head_dim % 8 == 0
-            and hb * seq_len * seq_len * 4 <= SCORE_TILE_BUDGET)
+            and hb * seq_len * seq_len * 4 <= SCORE_TILE_BUDGET // 2)
 
 
 def _fold(ref):

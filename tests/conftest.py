@@ -28,3 +28,19 @@ if os.environ.get("_DSTPU_TEST_ENV") != "1":
     env.setdefault("JAX_ENABLE_X64", "0")
     os.execve(sys.executable,
               [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+import pytest  # noqa: E402  (post-re-exec: safe to import)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Tier markers by location: tests/model/ is the 300-step convergence
+    tier (slow); everything else is the fast tier.  `-m fast` gives <5 min
+    signal; CI still runs the full suite (reference CI split:
+    azure-pipelines.yml unit vs model stages)."""
+    for item in items:
+        path = str(item.fspath).replace(os.sep, "/")
+        if "/tests/model/" in path:
+            item.add_marker(pytest.mark.slow)
+        elif (item.get_closest_marker("slow") is None
+              and item.get_closest_marker("distributed") is None):
+            item.add_marker(pytest.mark.fast)

@@ -20,6 +20,11 @@ from deepspeed_tpu import checkpoint as ckpt_mod
 from deepspeed_tpu.models import GPT2
 from deepspeed_tpu.parallel.topology import make_mesh
 
+# composition tier: 30-85 s of shard_map compiles per test — runs in the
+# full suite/CI, excluded from `-m fast` (VERDICT r2 weak #6)
+pytestmark = pytest.mark.slow
+
+
 VOCAB, SEQ = 64, 16
 
 

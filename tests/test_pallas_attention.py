@@ -88,7 +88,9 @@ def test_masked_rows_fully_padded_are_finite():
 
 def test_supported_gate():
     assert pattn.supported(128, 16, 64)
-    assert pattn.supported(256, 16, 64)       # 8-head block x 256^2 = 2 MB
+    # the gate is the BACKWARD budget (ADVICE r2): 8-head block x 256^2 x 4 B
+    # = 2 MB score tile exceeds the bwd half-budget even at bb=1
+    assert not pattn.supported(256, 16, 64)
     assert not pattn.supported(1024, 16, 64)  # score tile too big
     assert not pattn.supported(100, 16, 64)   # unaligned seq
     assert not pattn.supported(128, 16, 63)   # unaligned head dim

@@ -13,12 +13,18 @@ exercise the failure modes that agreement prevents:
 """
 
 import jax
+import pytest
 import numpy as np
 
 import deepspeed_tpu
 from deepspeed_tpu.parallel.topology import make_mesh
 
 from tests.test_models import gpt2_config, lm_batch, tiny_gpt2
+
+# composition tier: 30-85 s of shard_map compiles per test — runs in the
+# full suite/CI, excluded from `-m fast` (VERDICT r2 weak #6)
+pytestmark = pytest.mark.slow
+
 
 
 def _make_engine(mp, **cfg_over):
