@@ -92,6 +92,7 @@ class OptimizerFacade:
             g = {
                 "lr": d.get("lr", base.lr),
                 "betas": tuple(d.get("betas", (base.beta1, base.beta2))),
+                "weight_decay": d.get("weight_decay", base.weight_decay),
                 "name": base.name,
             }
             if "params" in d:
@@ -210,6 +211,7 @@ class DeepSpeedTpuEngine:
         self.dp_world_size = mesh.shape[DATA_AXIS]
         self.mp_world_size = mesh.shape[MODEL_AXIS]
         self.sp_world_size = mesh.shape.get(SEQ_AXIS, 1)
+        self._warned_sp_heuristic = False
         self.pp_world_size = mesh.shape.get(PIPE_AXIS, 1)
         if self.pp_world_size > 1 and self.sp_world_size > 1:
             raise DeepSpeedConfigError(
@@ -405,16 +407,15 @@ class DeepSpeedTpuEngine:
             if "params" not in d:
                 raise DeepSpeedConfigError(
                     "each param_groups entry needs a 'params' path regex")
-            extra = set(d) - {"params", "lr"}
+            extra = set(d) - {"params", "lr", "betas", "weight_decay"}
             if extra:
-                # per-group betas/weight_decay are NOT plumbed into the
-                # jitted step (momentum is global, like the reference FP16
-                # wrapper) — rejecting beats silently training with other
-                # hyperparameters than the facade displays
+                # anything beyond the four plumbed hypers would silently
+                # train with other hyperparameters than the facade displays
                 raise DeepSpeedConfigError(
                     f"param_groups entry has unsupported keys {sorted(extra)}:"
-                    f" only per-group 'lr' is supported (betas/momentum are "
-                    f"global)")
+                    f" supported per-group hyperparameters are 'lr', 'betas' "
+                    f"and 'weight_decay' (reference torch groups, "
+                    f"deepspeed_fused_lamb.py:77-100)")
         pats = [re.compile(d["params"]) for d in defs]
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
 
@@ -727,6 +728,17 @@ class DeepSpeedTpuEngine:
                     f"dim-1 lengths {sorted(dims)}: the engine cannot tell "
                     f"which are sequences — define batch_specs(batch) on the "
                     f"model to declare per-leaf shardings")
+            if not self._warned_sp_heuristic:
+                # ADVICE r2: a non-sequence leaf whose dim 1 happens to equal
+                # the sequence length (e.g. [B, F] with F == T) is still
+                # sharded over the seq axis by this heuristic — the model
+                # cannot be told apart from the batch alone
+                self._warned_sp_heuristic = True
+                logger.warning(
+                    "context_parallel_size>1 without model.batch_specs: "
+                    "assuming dim 1 of every >=2-D batch leaf is the "
+                    "sequence axis; define batch_specs(batch) on the model "
+                    "if any leaf's dim 1 is not a sequence")
 
         def spec(leaf):
             arr = np.asarray(leaf) if not hasattr(leaf, "ndim") else leaf
@@ -1014,13 +1026,16 @@ class DeepSpeedTpuEngine:
         group_ids = self._group_ids
         multi_group = len(self._group_defs) > 1
 
-        def step_local(master, opt_state, grads, ls_state, lr, b1, b2, normw):
-            # lr arrives as a [G] vector (one per param group); expand to a
-            # per-leaf tree when groups exist, else the plain scalar
+        def step_local(master, opt_state, grads, ls_state, lr, b1, b2, wd,
+                       normw):
+            # hypers arrive as [G] vectors (one per param group); expand to
+            # per-leaf trees when groups exist, else the plain scalars
             if zero or not multi_group:
-                lr = lr[0]
+                lr, b1, b2, wd = lr[0], b1[0], b2[0], wd[0]
             else:
-                lr = jax.tree_util.tree_map(lambda gid: lr[gid], group_ids)
+                expand = lambda vec: jax.tree_util.tree_map(
+                    lambda gid: vec[gid], group_ids)
+                lr, b1, b2, wd = expand(lr), expand(b1), expand(b2), expand(wd)
             if zero:
                 if zero_2d:
                     # [1, part] local blocks of the [mp, local_padded] layout
@@ -1061,7 +1076,8 @@ class DeepSpeedTpuEngine:
                     if clip > 0 else 1.0)
                 new_master, new_opt = opt.update(
                     {"flat": master_1d}, {"flat": gpart}, opt_in,
-                    lr=lr, beta1=b1, beta2=b2, combined_scale=combined)
+                    lr=lr, beta1=b1, beta2=b2, weight_decay=wd,
+                    combined_scale=combined)
                 new_master = new_master["flat"]
                 if fp16:
                     # skip-on-overflow (reference zero_optimizer.py:349-359);
@@ -1119,7 +1135,8 @@ class DeepSpeedTpuEngine:
                     if clip > 0 else 1.0)
                 new_master, new_opt = opt.update(
                     master, grads, opt_state,
-                    lr=lr, beta1=b1, beta2=b2, combined_scale=combined)
+                    lr=lr, beta1=b1, beta2=b2, weight_decay=wd,
+                    combined_scale=combined)
                 if fp16:
                     new_master = jax.tree_util.tree_map(
                         lambda new, old: jnp.where(overflow, old, new),
@@ -1163,17 +1180,17 @@ class DeepSpeedTpuEngine:
     def _build_step(self):
         step_local = self._make_step_local()
 
-        def local(master, opt_state, acc, ls_state, lr, b1, b2, normw):
+        def local(master, opt_state, acc, ls_state, lr, b1, b2, wd, normw):
             # acc leaves arrive as [1, ...] local slices
             grads = jax.tree_util.tree_map(lambda g: g[0], acc)
             return step_local(master, opt_state, grads, ls_state, lr, b1, b2,
-                              normw)
+                              wd, normw)
 
         master_spec, opt_spec, ls_spec = self._step_specs()
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(master_spec, opt_spec, self._grad_stack_specs(),
-                      ls_spec, P(), P(), P(), P(DATA_AXIS)),
+                      ls_spec, P(), P(), P(), P(), P(DATA_AXIS)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P()),
             check_vma=False)
@@ -1269,16 +1286,20 @@ class DeepSpeedTpuEngine:
                 getattr(self, "sample_count", self.global_steps))
 
     def _current_hypers(self):
-        """Live hyperparameters from the facade groups: ``lr`` is a [G]
-        vector (one entry per param group — the scheduler may have written
-        different LRs into each); betas come from group 0 (momentum is
-        global, like the reference's FP16 wrapper)."""
+        """Live hyperparameters from the facade groups, each a [G] vector
+        (one entry per param group): LR schedules may have written different
+        LRs into each group, OneCycle cycles per-group betas
+        (lr_schedules.py), and decay-excluded groups carry weight_decay=0
+        (the published BERT recipe, reference
+        docs/_tutorials/bert-pretraining.md:289-305)."""
+        base = self.base_optimizer
         groups = self.optimizer.param_groups
-        b1, b2 = groups[0].get("betas", (self.base_optimizer.beta1,
-                                         self.base_optimizer.beta2))
+        betas = [g.get("betas", (base.beta1, base.beta2)) for g in groups]
         return (jnp.asarray([g["lr"] for g in groups], jnp.float32),
-                jnp.asarray(b1, jnp.float32),
-                jnp.asarray(b2, jnp.float32))
+                jnp.asarray([b[0] for b in betas], jnp.float32),
+                jnp.asarray([b[1] for b in betas], jnp.float32),
+                jnp.asarray([g.get("weight_decay", base.weight_decay)
+                             for g in groups], jnp.float32))
 
     def step(self):
         """Optimizer boundary step (reference deepspeed_light.py:709-807)."""
@@ -1292,11 +1313,11 @@ class DeepSpeedTpuEngine:
             if self._step_fn is None:
                 self._step_fn = self._build_step()
             master = self.master_flat if self.zero_enabled else self.master
-            lr, b1, b2 = self._current_hypers()
+            lr, b1, b2, wd = self._current_hypers()
             (self.params, new_master, self.opt_state, self.loss_scale_state,
              overflow, self._last_grad_norm) = self._step_fn(
                 master, self.opt_state, self._acc, self.loss_scale_state,
-                lr, b1, b2, self._zero_norm_w)
+                lr, b1, b2, wd, self._zero_norm_w)
             if self.zero_enabled:
                 self.master_flat = new_master
             else:
@@ -1332,7 +1353,7 @@ class DeepSpeedTpuEngine:
         loss_and_grads = self._make_loss_and_grads()
         step_local = self._make_step_local()
 
-        def local(params, master, opt_state, ls_state, lr, b1, b2,
+        def local(params, master, opt_state, ls_state, lr, b1, b2, wd,
                   normw, batch_args):
             if gas == 1:
                 # no accumulator buffer, no scan machinery
@@ -1358,7 +1379,7 @@ class DeepSpeedTpuEngine:
                 last_loss = jax.tree_util.tree_map(lambda l: l[-1], losses)
             (params_new, master_new, opt_new, ls_new, overflow,
              total_norm) = step_local(master, opt_state, acc, ls_state,
-                                      lr, b1, b2, normw)
+                                      lr, b1, b2, wd, normw)
             return (params_new, master_new, opt_new, ls_new, overflow,
                     total_norm, last_loss)
 
@@ -1366,7 +1387,8 @@ class DeepSpeedTpuEngine:
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
-                      P(), P(), P(), P(DATA_AXIS), self._batch_specs(batch)),
+                      P(), P(), P(), P(), P(DATA_AXIS),
+                      self._batch_specs(batch)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P(), P()),
             check_vma=False)
@@ -1405,11 +1427,11 @@ class DeepSpeedTpuEngine:
         if self._train_batch_fn is None:
             self._train_batch_fn = self._build_train_batch(batch)
         master = self.master_flat if self.zero_enabled else self.master
-        lr, b1, b2 = self._current_hypers()
+        lr, b1, b2, wd = self._current_hypers()
         (self.params, new_master, self.opt_state, self.loss_scale_state,
          overflow, self._last_grad_norm, loss) = self._train_batch_fn(
             self.params, master, self.opt_state, self.loss_scale_state,
-            lr, b1, b2, self._zero_norm_w, batch)
+            lr, b1, b2, wd, self._zero_norm_w, batch)
         if self.zero_enabled:
             self.master_flat = new_master
         else:

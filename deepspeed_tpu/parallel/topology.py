@@ -163,6 +163,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if process_id is None:
         process_id = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
 
+    if jax.distributed.is_initialized():
+        # already rendezvoused (e.g. the user called init_distributed before
+        # constructing the engine, whose ctor re-runs it off the env
+        # contract) — a second jax.distributed.initialize would raise
+        logger.info("init_distributed: already initialized, skipping")
+        return
+
     if num_processes <= 1 and not explicit_coordinator:
         # nothing to rendezvous — covers launcher-spawned 1-process runs that
         # export DSTPU_COORDINATOR (jax.distributed.initialize would fail if

@@ -1,0 +1,137 @@
+"""Multi-process distributed test harness.
+
+Analog of the reference's ``@distributed_test`` fixture
+(/root/reference/tests/unit/common.py:14-100), which forks N
+torch.multiprocessing workers against a 127.0.0.1:29500 rendezvous and
+converts hangs/signals/nonzero exits into pytest failures.  Here each worker
+is a REAL fresh interpreter (the axon PJRT plugin registers at interpreter
+start, so in-process forking cannot give workers a clean CPU backend) that
+rendezvouses through ``jax.distributed.initialize`` — driven by the SAME
+``DSTPU_COORDINATOR`` / ``DSTPU_NUM_PROCESSES`` / ``DSTPU_PROCESS_ID`` env
+contract the launcher exports (launcher/launch.py), so a renamed env var or
+broken ``topology.init_distributed`` fails here first.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+WORKER_MAIN = os.path.join(HERE, "worker_main.py")
+
+# grace period after the first worker exits before stragglers are killed
+# (reference common.py joins remaining procs with a 10 s timeout)
+GRACE = 20.0
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker_env(pid: int, world_size: int, port: int, local_devices: int,
+               extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",         # no axon PJRT in workers
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={local_devices}",
+        # the launcher's rendezvous contract (launcher/launch.py:71-79)
+        "DSTPU_COORDINATOR": f"127.0.0.1:{port}",
+        "DSTPU_NUM_PROCESSES": str(world_size),
+        "DSTPU_PROCESS_ID": str(pid),
+    })
+    env.update(extra or {})
+    return env
+
+
+def spawn_distributed(func_name: str, world_size: int = 2,
+                      local_devices: int = 2, timeout: float = 420.0,
+                      env_extra: dict | None = None) -> list:
+    """Run ``workers.<func_name>()`` in ``world_size`` real processes.
+
+    Returns the per-process stdout+stderr text (asserting success);
+    raises AssertionError with all captured output on any failure, timeout,
+    or missing completion sentinel.
+    """
+    import tempfile
+
+    port = free_port()
+    procs, logfiles = [], []
+    for pid in range(world_size):
+        # workers write to FILES, not PIPEs: a verbose failing worker would
+        # fill the ~64 KB pipe buffer, block on write, and turn a crisp
+        # assertion into a timeout with truncated output
+        lf = tempfile.TemporaryFile(mode="w+")
+        logfiles.append(lf)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", WORKER_MAIN, func_name],
+            env=worker_env(pid, world_size, port, local_devices, env_extra),
+            cwd=REPO, stdout=lf, stderr=subprocess.STDOUT, text=True))
+
+    def read_log(pid):
+        logfiles[pid].seek(0)
+        return logfiles[pid].read()
+
+    deadline = time.time() + timeout
+    outs: list = [None] * world_size
+    try:
+        first_exit = None
+        pending = set(range(world_size))
+        while pending:
+            now = time.time()
+            hard = deadline if first_exit is None else min(
+                deadline, first_exit + GRACE)
+            if now >= hard:
+                raise TimeoutError(
+                    f"workers {sorted(pending)} still running "
+                    f"({'past deadline' if now >= deadline else 'straggler'})")
+            for pid in sorted(pending):
+                if procs[pid].poll() is not None:
+                    outs[pid] = read_log(pid)
+                    pending.discard(pid)
+                    if first_exit is None:
+                        first_exit = time.time()
+            time.sleep(0.2)
+    except TimeoutError as e:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for pid in range(world_size):
+            if outs[pid] is None:
+                outs[pid] = read_log(pid)
+        raise AssertionError(
+            f"distributed test {func_name!r} hung: {e}\n" + _dump(outs))
+    finally:
+        for lf in logfiles:
+            lf.close()
+
+    bad = [pid for pid in range(world_size) if procs[pid].returncode != 0]
+    if bad:
+        raise AssertionError(
+            f"distributed test {func_name!r}: workers {bad} exited nonzero "
+            f"({[procs[b].returncode for b in bad]})\n" + _dump(outs))
+    missing = [pid for pid in range(world_size)
+               if f"WORKER_OK rank={pid}" not in (outs[pid] or "")]
+    if missing:
+        raise AssertionError(
+            f"distributed test {func_name!r}: workers {missing} exited 0 "
+            f"without the completion sentinel\n" + _dump(outs))
+    return outs
+
+
+def _dump(outs) -> str:
+    parts = []
+    for pid, out in enumerate(outs):
+        parts.append(f"--- worker {pid} ---\n{out or '<no output>'}")
+    return "\n".join(parts)

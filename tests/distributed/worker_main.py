@@ -1,0 +1,33 @@
+"""Entry point executed in each spawned distributed-test worker.
+
+Order matters: ``init_distributed()`` MUST run before anything initialises
+the XLA backend (jax.distributed.initialize's own contract), and it is driven
+purely by the DSTPU_* env contract — the exact code path a launcher-spawned
+training process takes (launcher/launch.py → topology.init_distributed).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    sys.path.insert(0, repo)                        # deepspeed_tpu
+    sys.path.insert(0, os.path.join(repo, "tests"))  # simple_model
+    sys.path.insert(0, here)                        # workers
+
+    from deepspeed_tpu.parallel.topology import init_distributed
+    init_distributed()          # no args: the env contract is under test
+
+    import workers
+    fn = getattr(workers, sys.argv[1])
+    fn()
+
+    import jax
+    print(f"WORKER_OK rank={jax.process_index()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
