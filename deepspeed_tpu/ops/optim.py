@@ -101,18 +101,44 @@ class Optimizer:
                lr: Optional[float] = None,
                beta1: Optional[float] = None,
                beta2: Optional[float] = None,
+               weight_decay: Optional[float] = None,
                combined_scale=1.0) -> Tuple[Any, OptimizerState]:
         raise NotImplementedError
 
     @staticmethod
-    def _lr_leaves(lr, treedef, n):
-        """``lr`` may be a scalar (all leaves share it) or a pytree matching
-        params (per-leaf LRs — the engine's param-group path).  Returns a
-        flat list of per-leaf scalars."""
-        if lr is None or isinstance(lr, (int, float)) or (
-                hasattr(lr, "ndim") and lr.ndim == 0):
-            return [lr] * n
-        return treedef.flatten_up_to(lr)
+    def _hyper_leaves(val, treedef, n):
+        """A hyperparameter (lr/beta1/beta2/weight_decay) may be a scalar
+        (all leaves share it) or a pytree matching params (per-leaf values —
+        the engine's param-group path, reference torch param groups carrying
+        arbitrary hypers, deepspeed_fused_lamb.py:77-100).  Returns a flat
+        list of per-leaf scalars (None = use the optimizer's default)."""
+        if val is None or isinstance(val, (int, float)) or (
+                hasattr(val, "ndim") and val.ndim == 0):
+            return [val] * n
+        return treedef.flatten_up_to(val)
+
+    def _resolve(self, lr_leaf, b1_leaf, b2_leaf, wd_leaf):
+        """Per-leaf hypers with the optimizer's static fields as defaults."""
+        return (self.lr if lr_leaf is None else lr_leaf,
+                self.beta1 if b1_leaf is None else b1_leaf,
+                self.beta2 if b2_leaf is None else b2_leaf,
+                self.weight_decay if wd_leaf is None else wd_leaf)
+
+    def _flat_hypers(self, params, grads, state, lr, beta1, beta2,
+                     weight_decay):
+        """Flatten params/grads/moments and the four hypers together."""
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        n = len(flat_p)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = (treedef.flatten_up_to(state.m)
+                  if state.m is not None else [None] * n)
+        flat_v = (treedef.flatten_up_to(state.v)
+                  if state.v is not None else [None] * n)
+        hy = zip(self._hyper_leaves(lr, treedef, n),
+                 self._hyper_leaves(beta1, treedef, n),
+                 self._hyper_leaves(beta2, treedef, n),
+                 self._hyper_leaves(weight_decay, treedef, n))
+        return treedef, list(zip(flat_p, flat_g, flat_m, flat_v, hy))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,42 +149,35 @@ class Adam(Optimizer):
     decoupled_decay: bool = False
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
-               combined_scale=1.0):
-        b1 = self.beta1 if beta1 is None else beta1
-        b2 = self.beta2 if beta2 is None else beta2
+               weight_decay=None, combined_scale=1.0):
         step = state.step + 1
 
-        def leaf(p, g, m, v, lr_leaf):
-            lr_l = self.lr if lr_leaf is None else lr_leaf
-            step_size = self._step_size(lr_l, step.astype(jnp.float32),
-                                        b1, b2)
+        def leaf(p, g, m, v, hy):
             if g is None:
                 return p, m, v
+            lr_l, b1, b2, wd = self._resolve(*hy)
+            step_size = self._step_size(lr_l, step.astype(jnp.float32),
+                                        b1, b2)
             from deepspeed_tpu.ops import pallas_optim as pk
             if pk.should_use_pallas(p.size, self.use_pallas):
                 return pk.fused_adam_update(
                     p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
-                    weight_decay=self.weight_decay,
+                    weight_decay=wd,
                     combined_scale=combined_scale, step_size=step_size,
                     lr=lr_l, eps_inside_sqrt=self.eps_inside_sqrt,
                     decoupled_decay=self.decoupled_decay,
                     interpret=not pk.pallas_available())
             m_new, v_new = self._moments(g, m, v, b1, b2, combined_scale)
             upd = m_new / self._denom(v_new)
-            if self.weight_decay > 0.0 and not self.decoupled_decay:
-                upd = upd + self.weight_decay * p
-            p_new = p - step_size * upd
-            if self.weight_decay > 0.0 and self.decoupled_decay:
-                p_new = p_new - lr_l * self.weight_decay * p
+            if self.decoupled_decay:
+                p_new = p - step_size * upd - lr_l * wd * p
+            else:
+                p_new = p - step_size * (upd + wd * p)
             return p_new, m_new, v_new
 
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(state.m)
-        flat_v = treedef.flatten_up_to(state.v)
-        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
-        out = [leaf(p, g, m, v, l) for p, g, m, v, l in
-               zip(flat_p, flat_g, flat_m, flat_v, flat_lr)]
+        treedef, rows = self._flat_hypers(params, grads, state,
+                                          lr, beta1, beta2, weight_decay)
+        out = [leaf(*r) for r in rows]
         new_p = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
@@ -180,28 +199,26 @@ class Lamb(Optimizer):
     min_coeff: float = 0.01
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
-               combined_scale=1.0):
-        b1 = self.beta1 if beta1 is None else beta1
-        b2 = self.beta2 if beta2 is None else beta2
+               weight_decay=None, combined_scale=1.0):
         step = state.step + 1
 
-        def leaf(p, g, m, v, lr_leaf):
-            lr_l = self.lr if lr_leaf is None else lr_leaf
-            step_size = self._step_size(lr_l, step.astype(jnp.float32),
-                                        b1, b2)
+        def leaf(p, g, m, v, hy):
             if g is None:
                 return p, m, v
+            lr_l, b1, b2, wd = self._resolve(*hy)
+            step_size = self._step_size(lr_l, step.astype(jnp.float32),
+                                        b1, b2)
             from deepspeed_tpu.ops import pallas_optim as pk
             if pk.should_use_pallas(p.size, self.use_pallas):
                 return pk.fused_lamb_update(
                     p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
-                    weight_decay=self.weight_decay,
+                    weight_decay=wd,
                     combined_scale=combined_scale, step_size=step_size,
                     min_coeff=self.min_coeff, max_coeff=self.max_coeff,
                     eps_inside_sqrt=self.eps_inside_sqrt,
                     interpret=not pk.pallas_available())
             m_new, v_new = self._moments(g, m, v, b1, b2, combined_scale)
-            upd = m_new / self._denom(v_new) + self.weight_decay * p
+            upd = m_new / self._denom(v_new) + wd * p
             # two L2 reductions of kernel part1/part2
             w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
             u_norm = jnp.sqrt(jnp.sum(upd ** 2))
@@ -213,13 +230,9 @@ class Lamb(Optimizer):
             p_new = p - step_size * coeff * upd
             return p_new, m_new, v_new
 
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(state.m)
-        flat_v = treedef.flatten_up_to(state.v)
-        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
-        out = [leaf(p, g, m, v, l) for p, g, m, v, l in
-               zip(flat_p, flat_g, flat_m, flat_v, flat_lr)]
+        treedef, rows = self._flat_hypers(params, grads, state,
+                                          lr, beta1, beta2, weight_decay)
+        out = [leaf(*r) for r in rows]
         new_p = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
@@ -237,41 +250,33 @@ class Sgd(Optimizer):
         return OptimizerState(step=jnp.zeros((), jnp.int32), m=m, v=None)
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
-               combined_scale=1.0):
+               weight_decay=None, combined_scale=1.0):
         step = state.step + 1
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+        treedef, rows = self._flat_hypers(params, grads, state,
+                                          lr, beta1, beta2, weight_decay)
 
         if self.momentum > 0.0:
-            def leaf(p, g, m, lr_leaf):
+            def leaf(p, g, m, _v, hy):
                 if g is None:
                     return p, m
-                lr_l = self.lr if lr_leaf is None else lr_leaf
-                sg = g.astype(jnp.float32) / combined_scale
-                if self.weight_decay > 0.0:
-                    sg = sg + self.weight_decay * p
+                lr_l, _, _, wd = self._resolve(*hy)
+                sg = g.astype(jnp.float32) / combined_scale + wd * p
                 m_new = self.momentum * m + sg
                 return p - lr_l * m_new, m_new
-            flat_m = treedef.flatten_up_to(state.m)
-            out = [leaf(p, g, m, l) for p, g, m, l in
-                   zip(flat_p, flat_g, flat_m, flat_lr)]
+            out = [leaf(*r) for r in rows]
             return (treedef.unflatten([o[0] for o in out]),
                     OptimizerState(step=step,
                                    m=treedef.unflatten([o[1] for o in out]),
                                    v=None))
 
-        def leaf(p, g, lr_leaf):
+        def leaf(p, g, _m, _v, hy):
             if g is None:
                 return p
-            lr_l = self.lr if lr_leaf is None else lr_leaf
-            sg = g.astype(jnp.float32) / combined_scale
-            if self.weight_decay > 0.0:
-                sg = sg + self.weight_decay * p
+            lr_l, _, _, wd = self._resolve(*hy)
+            sg = g.astype(jnp.float32) / combined_scale + wd * p
             return p - lr_l * sg
 
-        new_p = treedef.unflatten(
-            [leaf(p, g, l) for p, g, l in zip(flat_p, flat_g, flat_lr)])
+        new_p = treedef.unflatten([leaf(*r) for r in rows])
         return new_p, OptimizerState(step=step, m=None, v=None)
 
 
@@ -288,25 +293,20 @@ class RMSprop(Optimizer):
                               v=_zeros_like_tree(params))
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
-               combined_scale=1.0):
+               weight_decay=None, combined_scale=1.0):
         step = state.step + 1
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_v = treedef.flatten_up_to(state.v)
-        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+        treedef, rows = self._flat_hypers(params, grads, state,
+                                          lr, beta1, beta2, weight_decay)
 
-        def leaf(p, g, v, lr_leaf):
+        def leaf(p, g, _m, v, hy):
             if g is None:
                 return p, v
-            lr_l = self.lr if lr_leaf is None else lr_leaf
-            sg = g.astype(jnp.float32) / combined_scale
-            if self.weight_decay > 0.0:
-                sg = sg + self.weight_decay * p
+            lr_l, _, _, wd = self._resolve(*hy)
+            sg = g.astype(jnp.float32) / combined_scale + wd * p
             v_new = self.alpha * v + (1.0 - self.alpha) * sg * sg
             return p - lr_l * sg / (jnp.sqrt(v_new) + self.eps), v_new
 
-        out = [leaf(p, g, v, l) for p, g, v, l in
-               zip(flat_p, flat_g, flat_v, flat_lr)]
+        out = [leaf(*r) for r in rows]
         return (treedef.unflatten([o[0] for o in out]),
                 OptimizerState(step=step, m=None,
                                v=treedef.unflatten([o[1] for o in out])))
@@ -324,25 +324,20 @@ class Adagrad(Optimizer):
                               v=_zeros_like_tree(params))
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
-               combined_scale=1.0):
+               weight_decay=None, combined_scale=1.0):
         step = state.step + 1
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_v = treedef.flatten_up_to(state.v)
-        flat_lr = self._lr_leaves(lr, treedef, len(flat_p))
+        treedef, rows = self._flat_hypers(params, grads, state,
+                                          lr, beta1, beta2, weight_decay)
 
-        def leaf(p, g, v, lr_leaf):
+        def leaf(p, g, _m, v, hy):
             if g is None:
                 return p, v
-            lr_l = self.lr if lr_leaf is None else lr_leaf
-            sg = g.astype(jnp.float32) / combined_scale
-            if self.weight_decay > 0.0:
-                sg = sg + self.weight_decay * p
+            lr_l, _, _, wd = self._resolve(*hy)
+            sg = g.astype(jnp.float32) / combined_scale + wd * p
             v_new = v + sg * sg
             return p - lr_l * sg / (jnp.sqrt(v_new) + self.eps), v_new
 
-        out = [leaf(p, g, v, l) for p, g, v, l in
-               zip(flat_p, flat_g, flat_v, flat_lr)]
+        out = [leaf(*r) for r in rows]
         return (treedef.unflatten([o[0] for o in out]),
                 OptimizerState(step=step, m=None,
                                v=treedef.unflatten([o[1] for o in out])))

@@ -65,12 +65,15 @@ def _geometry(n: int, block_rows: int) -> Tuple[int, int, int]:
 
 # --------------------------------------------------------------------- LAMB
 
-def _lamb_phase1_kernel(eps, weight_decay, eps_inside_sqrt,
+def _lamb_phase1_kernel(eps, eps_inside_sqrt,
                         scal_ref, p_ref, g_ref, m_ref, v_ref,
                         m_out, v_out, upd_out, norms_out, acc):
     b1 = scal_ref[0, 0]
     b2 = scal_ref[0, 1]
     inv_scale = scal_ref[0, 2]
+    # weight decay rides SMEM (not a compile-time constant) so per-group
+    # hyperparameters don't multiply compiled kernels
+    weight_decay = scal_ref[0, 4]
 
     i = pl.program_id(0)
 
@@ -122,8 +125,9 @@ def fused_lamb_update(p, g, m, v, *, beta1, beta2, eps, weight_decay,
     shape, n = p.shape, p.size
     rows, grid, block_rows = _geometry(n, block_rows)
     p2, g2, m2, v2 = (_tile(t, rows) for t in (p, g, m, v))
-    scalars = jnp.asarray(
-        [[beta1, beta2, 1.0 / combined_scale, step_size]], jnp.float32)
+    scalars = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                         (beta1, beta2, 1.0 / combined_scale, step_size,
+                          weight_decay)]).reshape(1, 5)
 
     blk = lambda: pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
@@ -132,9 +136,9 @@ def fused_lamb_update(p, g, m, v, *, beta1, beta2, eps, weight_decay,
 
     m_new, v_new, upd, norms = pl.pallas_call(
         functools.partial(_lamb_phase1_kernel, float(eps),
-                          float(weight_decay), bool(eps_inside_sqrt)),
+                          bool(eps_inside_sqrt)),
         grid=(grid,),
-        in_specs=[smem((1, 4)), blk(), blk(), blk(), blk()],
+        in_specs=[smem((1, 5)), blk(), blk(), blk(), blk()],
         out_specs=(blk(), blk(), blk(), smem((1, 2))),
         out_shape=(jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
                    jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
@@ -148,7 +152,7 @@ def fused_lamb_update(p, g, m, v, *, beta1, beta2, eps, weight_decay,
         functools.partial(_lamb_phase2_kernel, float(min_coeff),
                           float(max_coeff)),
         grid=(grid,),
-        in_specs=[smem((1, 4)), smem((1, 2)), blk(), blk()],
+        in_specs=[smem((1, 5)), smem((1, 2)), blk(), blk()],
         out_specs=blk(),
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
         interpret=interpret,
@@ -160,13 +164,15 @@ def fused_lamb_update(p, g, m, v, *, beta1, beta2, eps, weight_decay,
 
 # --------------------------------------------------------------------- Adam
 
-def _adam_kernel(eps, weight_decay, eps_inside_sqrt, decoupled, lr_decay,
+def _adam_kernel(eps, eps_inside_sqrt, decoupled,
                  scal_ref, p_ref, g_ref, m_ref, v_ref,
                  p_out, m_out, v_out):
     b1 = scal_ref[0, 0]
     b2 = scal_ref[0, 1]
     inv_scale = scal_ref[0, 2]
     step_size = scal_ref[0, 3]
+    lr = scal_ref[1, 0]
+    weight_decay = scal_ref[1, 1]   # SMEM, not compile-time: per-group wd
 
     g = g_ref[:] * inv_scale
     m_new = b1 * m_ref[:] + (1.0 - b1) * g
@@ -176,11 +182,11 @@ def _adam_kernel(eps, weight_decay, eps_inside_sqrt, decoupled, lr_decay,
     else:
         denom = jnp.sqrt(v_new) + eps
     upd = m_new / denom
-    if weight_decay > 0.0 and not decoupled:
+    if decoupled:
+        p_new = p_ref[:] - step_size * upd - (lr * weight_decay) * p_ref[:]
+    else:
         upd = upd + weight_decay * p_ref[:]
-    p_new = p_ref[:] - step_size * upd
-    if weight_decay > 0.0 and decoupled:
-        p_new = p_new - (lr_decay * weight_decay) * p_ref[:]
+        p_new = p_ref[:] - step_size * upd
     p_out[:] = p_new
     m_out[:] = m_new
     v_out[:] = v_new
@@ -197,32 +203,20 @@ def fused_adam_update(p, g, m, v, *, beta1, beta2, eps, weight_decay,
     shape, n = p.shape, p.size
     rows, grid, block_rows = _geometry(n, block_rows)
     p2, g2, m2, v2 = (_tile(t, rows) for t in (p, g, m, v))
-    scalars = jnp.asarray(
-        [[beta1, beta2, 1.0 / combined_scale, step_size]], jnp.float32)
+    scalars = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                         (beta1, beta2, 1.0 / combined_scale, step_size,
+                          lr, weight_decay, 0.0, 0.0)]).reshape(2, 4)
 
     blk = lambda: pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
     smem = lambda shape_: pl.BlockSpec(shape_, lambda i: (0, 0),
                                        memory_space=pltpu.SMEM)
 
-    # decoupled decay needs lr as a traced scalar: fold into the scalars row
-    lr_decay = lr if decoupled_decay else 0.0
-    scalars = jnp.concatenate(
-        [scalars, jnp.asarray([[lr_decay, 0.0, 0.0, 0.0]], jnp.float32)],
-        axis=0) if decoupled_decay else scalars
-
-    def kernel(scal_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
-        lr_d = scal_ref[1, 0] if decoupled_decay else 0.0
-        _adam_kernel(float(eps), float(weight_decay), bool(eps_inside_sqrt),
-                     bool(decoupled_decay), lr_d,
-                     scal_ref, p_ref, g_ref, m_ref, v_ref,
-                     p_out, m_out, v_out)
-
-    srows = 2 if decoupled_decay else 1
     p_new, m_new, v_new = pl.pallas_call(
-        kernel,
+        functools.partial(_adam_kernel, float(eps), bool(eps_inside_sqrt),
+                          bool(decoupled_decay)),
         grid=(grid,),
-        in_specs=[smem((srows, 4)), blk(), blk(), blk(), blk()],
+        in_specs=[smem((2, 4)), blk(), blk(), blk(), blk()],
         out_specs=(blk(), blk(), blk()),
         out_shape=(jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
                    jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
