@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import logging
 import os
+import weakref
 from typing import Any, Callable, Optional
 
 import jax
@@ -68,6 +69,144 @@ def _as_tuple(batch):
     if isinstance(batch, (tuple, list)):
         return tuple(batch)
     return (batch,)
+
+
+class _PendingStep:
+    """A train-mode forward whose fused fwd+bwd program has not run yet.
+
+    The reference's ``forward`` is forward-only and ``backward`` is
+    backward-only (deepspeed_light.py:603-696); this engine fuses both into
+    one XLA program for dispatch efficiency, so the grad computation is
+    *deferred* here until ``backward()`` (or until the caller materializes a
+    loss value).  A pending step whose loss object becomes unreachable
+    without ever being observed or backward-ed is dropped unexecuted (see
+    ``_force_live_pendings``) — it costs nothing.
+    """
+
+    def __init__(self, engine, batch):
+        self.engine = engine
+        self.batch = batch
+        self.loss = None  # filled by force()
+
+    @property
+    def forced(self):
+        return self.loss is not None
+
+    def force(self):
+        if self.loss is None:
+            e = self.engine
+            loss, grads = e._fwdbwd_fn(
+                e.params, e.loss_scale_state.cur_scale, self.batch)
+            # only the engine's CURRENT pending may feed a later backward();
+            # a superseded one must not poison the cached grads / last loss
+            if e._pending is self:
+                e._cached_grads = grads
+                e._last_loss = loss
+            self.loss = loss
+            # the loss values are all a _DeferredLoss can still need; don't
+            # pin the micro-batch (or the engine) for its lifetime
+            self.batch = None
+            self.engine = None
+        return self.loss
+
+
+class _DeferredLoss:
+    """Lazy scalar returned by train-mode ``forward()``.
+
+    Materializing it (``float``, ``np.asarray``, ``jnp`` ops, arithmetic,
+    attribute access) runs the engine's fused fwd+bwd program once; the
+    subsequent ``backward()`` reuses the cached gradients so the step still
+    costs exactly one program.  Probing losses without training should use
+    ``engine.eval()``, whose forward program carries no backward.
+    """
+
+    def __init__(self, pending, index):
+        self._pending = pending
+        self._index = index
+
+    def force(self):
+        loss = self._pending.force()
+        return jax.tree_util.tree_leaves(loss)[self._index]
+
+    # --- materialization protocols
+    def __jax_array__(self):
+        return jnp.asarray(self.force())
+
+    def __array__(self, dtype=None):
+        import numpy as _np
+        return _np.asarray(self.force(), dtype=dtype)
+
+    def __float__(self):
+        return float(self.force())
+
+    def __int__(self):
+        return int(self.force())
+
+    def __bool__(self):
+        return bool(self.force())
+
+    def __repr__(self):
+        return repr(self.force())
+
+    def __format__(self, spec):
+        return format(self.force(), spec)
+
+    # --- arithmetic (loss scaling / summing before backward)
+    def __add__(self, o):
+        return self.force() + _resolve_loss(o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self.force() - _resolve_loss(o)
+
+    def __rsub__(self, o):
+        return _resolve_loss(o) - self.force()
+
+    def __mul__(self, o):
+        return self.force() * _resolve_loss(o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self.force() / _resolve_loss(o)
+
+    def __rtruediv__(self, o):
+        return _resolve_loss(o) / self.force()
+
+    def __neg__(self):
+        return -self.force()
+
+    # --- comparisons (early stopping / logging on the train loss)
+    def __eq__(self, o):
+        return self.force() == _resolve_loss(o)
+
+    def __ne__(self, o):
+        return self.force() != _resolve_loss(o)
+
+    def __lt__(self, o):
+        return self.force() < _resolve_loss(o)
+
+    def __le__(self, o):
+        return self.force() <= _resolve_loss(o)
+
+    def __gt__(self, o):
+        return self.force() > _resolve_loss(o)
+
+    def __ge__(self, o):
+        return self.force() >= _resolve_loss(o)
+
+    __hash__ = object.__hash__
+
+    def __getattr__(self, name):
+        # .item(), .shape, .dtype, .astype, .block_until_ready, ...
+        return getattr(self.force(), name)
+
+
+def _resolve_loss(x):
+    """Replace any _DeferredLoss leaves in a loss pytree with real arrays."""
+    return jax.tree_util.tree_map(
+        lambda l: l.force() if isinstance(l, _DeferredLoss) else l, x)
 
 
 class OptimizerFacade:
@@ -376,6 +515,9 @@ class DeepSpeedTpuEngine:
         self._train_batch_fn = None
         self._acc = None            # accumulated local grads ([dp, ...] tree)
         self._cached_grads = None   # grads from the last forward
+        self._pending = None        # latest train-mode forward not yet run
+        self._pending_refs = []     # weakrefs to every unforced _PendingStep
+        self._loss_treedef = None   # model loss pytree structure (cached)
         self._last_loss = None
         self._profiling = False
 
@@ -416,6 +558,12 @@ class DeepSpeedTpuEngine:
                     f" supported per-group hyperparameters are 'lr', 'betas' "
                     f"and 'weight_decay' (reference torch groups, "
                     f"deepspeed_fused_lamb.py:77-100)")
+            if "betas" in d and not self.base_optimizer.uses_betas:
+                # same contract: the group would display betas the update
+                # rule never reads
+                raise DeepSpeedConfigError(
+                    f"per-group 'betas' given but optimizer "
+                    f"'{self.base_optimizer.name}' does not consume betas")
         pats = [re.compile(d["params"]) for d in defs]
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
 
@@ -932,27 +1080,60 @@ class DeepSpeedTpuEngine:
             check_vma=False)
         return jax.jit(fn)
 
+    def _force_live_pendings(self):
+        """Execute every deferred forward whose loss object is still
+        reachable, before engine state (params / loss scale) mutates under
+        it — so its values come out as if it had run eagerly at issue time.
+        Pendings whose loss objects are already unreachable are dropped
+        without ever running."""
+        for ref in self._pending_refs:
+            p = ref()
+            if p is not None and not p.forced:
+                p.force()
+        self._pending_refs = []
+        self._pending = None
+
     def forward(self, *inputs):
-        """Compute loss (and, in train mode, cache local grads).
+        """Compute loss (and, in train mode, record the micro-batch for the
+        deferred fused fwd+bwd program — see _PendingStep).
         Reference deepspeed_light.py:603-623."""
         wcb = self.wall_clock_breakdown()
         if wcb:
             self.timers(FORWARD_TIMER).start()
         batch = inputs
         if self.training:
+            # the superseded pending stays executable through the
+            # _DeferredLoss the caller may hold; it is forced lazily or at
+            # the next param mutation (an eval-mode forward leaves the live
+            # train pending in place — backward() may still consume it)
+            self._pending = None
             if self._fwdbwd_fn is None:
                 self._fwdbwd_fn = self._build_fwdbwd(batch)
-            loss, grads = self._fwdbwd_fn(
-                self.params, self.loss_scale_state.cur_scale, batch)
-            self._cached_grads = grads
-            self._last_loss = loss
+            if self._loss_treedef is None:
+                loss_shape, _ = jax.eval_shape(
+                    self._fwdbwd_fn, self.params,
+                    self.loss_scale_state.cur_scale, batch)
+                self._loss_treedef = jax.tree_util.tree_structure(loss_shape)
+            self._pending = _PendingStep(self, batch)
+            self._pending_refs = [r for r in self._pending_refs
+                                  if r() is not None]
+            self._pending_refs.append(weakref.ref(self._pending))
+            n = self._loss_treedef.num_leaves
+            loss = jax.tree_util.tree_unflatten(
+                self._loss_treedef,
+                [_DeferredLoss(self._pending, i) for i in range(n)])
+            if wcb:
+                # dispatch-only under the fused design; the model compute is
+                # timed by backward_inner (docs/features.md "wall-clock
+                # breakdown")
+                self.timers(FORWARD_TIMER).stop()
         else:
             if self._eval_fn is None:
                 self._eval_fn = self._build_eval(batch)
             loss = self._eval_fn(self.params, batch)
             self._last_loss = loss
-        if wcb:
-            self.timers(FORWARD_TIMER).stop(sync_on=loss)
+            if wcb:
+                self.timers(FORWARD_TIMER).stop(sync_on=loss)
         return loss
 
     __call__ = forward
@@ -973,11 +1154,22 @@ class DeepSpeedTpuEngine:
             raise NotImplementedError(
                 "allreduce_gradients=False is not supported under SPMD: the "
                 "boundary step owns the gradient reduction")
-        assert self._cached_grads is not None, \
+        assert self._pending is not None or self._cached_grads is not None, \
             "backward() must follow a forward() in train mode"
         wcb = self.wall_clock_breakdown()
         if wcb:
             self.timers(BACKWARD_TIMER).start()
+
+        if self._pending is not None:
+            # run the deferred fused fwd+bwd program (one program per micro
+            # step; reference's backward_inner span = the model bwd compute)
+            if wcb:
+                self.timers(BACKWARD_INNER_TIMER).start()
+            self._pending.force()
+            if wcb:
+                self.timers(BACKWARD_INNER_TIMER).stop(
+                    sync_on=self._pending.loss)
+            self._pending = None
 
         if self.summary_writer is not None and self.is_gradient_accumulation_boundary():
             self.sample_count = (self.train_micro_batch_size_per_gpu()
@@ -988,6 +1180,12 @@ class DeepSpeedTpuEngine:
                 self.summary_writer.add_scalar("Train/Samples/train_loss",
                                                scalar, self.sample_count)
 
+        if wcb:
+            # the cross-DP reduction itself is deferred to the boundary step
+            # program (same bytes on the wire as the reference's
+            # boundary-only allreduce); this span covers the on-device
+            # micro-step accumulate — see docs/features.md
+            self.timers(BACKWARD_REDUCE_TIMER).start()
         if self._acc is None:
             self._acc = self._cached_grads
         else:
@@ -995,13 +1193,14 @@ class DeepSpeedTpuEngine:
                                                self._cached_grads)
         self._cached_grads = None
         if wcb:
-            self.timers(BACKWARD_TIMER).stop(sync_on=self._acc)
+            self.timers(BACKWARD_REDUCE_TIMER).stop(sync_on=self._acc)
+            self.timers(BACKWARD_TIMER).stop()
         # the reference returns the grad-accum-scaled loss from backward
         # (asserted by tests/unit/test_multi_output_model.py)
         if loss is None:
             return None
         gas = float(self.gradient_accumulation_steps())
-        return jax.tree_util.tree_map(lambda l: l / gas, loss)
+        return jax.tree_util.tree_map(lambda l: l / gas, _resolve_loss(loss))
 
     # ------------------------------------------------------------------- step
 
@@ -1310,6 +1509,7 @@ class DeepSpeedTpuEngine:
 
         if self.is_gradient_accumulation_boundary():
             assert self._acc is not None, "step() with no accumulated grads"
+            self._force_live_pendings()  # about to mutate params
             if self._step_fn is None:
                 self._step_fn = self._build_step()
             master = self.master_flat if self.zero_enabled else self.master
@@ -1332,12 +1532,16 @@ class DeepSpeedTpuEngine:
             # per-span TB events (reference deepspeed_light.py:770-781 writes
             # Train/Samples/elapsed_time_ms_* alongside the console log)
             if self.summary_writer is not None:
-                for name in (FORWARD_TIMER, BACKWARD_TIMER, STEP_TIMER):
+                for name in (FORWARD_TIMER, BACKWARD_TIMER,
+                             BACKWARD_INNER_TIMER, BACKWARD_REDUCE_TIMER,
+                             STEP_TIMER):
                     self.summary_writer.add_scalar(
                         f"Train/Samples/elapsed_time_ms_{name}",
                         self.timers(name).elapsed(reset=False) * 1000.0,
                         getattr(self, "sample_count", self.global_steps))
-            self.timers.log([FORWARD_TIMER, BACKWARD_TIMER, STEP_TIMER],
+            self.timers.log([FORWARD_TIMER, BACKWARD_TIMER,
+                            BACKWARD_INNER_TIMER, BACKWARD_REDUCE_TIMER,
+                            STEP_TIMER],
                             memory_breakdown=self.config.memory_breakdown)
 
     # --------------------------------------------------------- fused hot path
@@ -1411,6 +1615,7 @@ class DeepSpeedTpuEngine:
         micro-batches globally.  The summed gradient over the effective batch
         is identical either way.  Returns the last micro-step's loss."""
         assert self.training, "train_batch() requires train mode"
+        self._force_live_pendings()  # train_batch mutates params
         batch = _as_tuple(batch)
         gas = self.gradient_accumulation_steps()
         leads = {x.shape[0] for x in jax.tree_util.tree_leaves(batch)}
@@ -1466,6 +1671,7 @@ class DeepSpeedTpuEngine:
                         load_lr_scheduler_states=True):
         """reference deepspeed_light.py:974-1046; returns (path,
         client_state)."""
+        self._force_live_pendings()  # deferred forwards saw the old params
         from deepspeed_tpu import checkpoint as ckpt_mod
         path, client = ckpt_mod.load_checkpoint(
             self, load_dir, tag=tag,
@@ -1488,6 +1694,7 @@ class DeepSpeedTpuEngine:
         return sd
 
     def _optimizer_load_state_dict(self, sd):
+        self._force_live_pendings()  # deferred forwards saw the old state
         self.opt_state = jax.tree_util.tree_map(
             lambda old, new: jax.device_put(jnp.asarray(new), old.sharding),
             self.opt_state, sd["opt_state"])

@@ -69,6 +69,10 @@ class Optimizer:
     use_pallas: Optional[bool] = None
     name: str = "base"
 
+    # whether update() consumes beta1/beta2 — engine param-group validation
+    # rejects per-group 'betas' for optimizers that would silently drop them
+    uses_betas = True
+
     def init(self, params) -> OptimizerState:
         return OptimizerState(step=jnp.zeros((), jnp.int32),
                               m=_zeros_like_tree(params),
@@ -241,9 +245,11 @@ class Lamb(Optimizer):
 
 @dataclasses.dataclass(frozen=True)
 class Sgd(Optimizer):
-    """torch.optim.SGD passthrough equivalent (momentum via beta1)."""
+    """torch.optim.SGD passthrough equivalent (momentum is a static field,
+    not a per-step beta)."""
     name: str = "sgd"
     momentum: float = 0.0
+    uses_betas = False
 
     def init(self, params) -> OptimizerState:
         m = _zeros_like_tree(params) if self.momentum > 0.0 else None
@@ -287,6 +293,7 @@ class RMSprop(Optimizer):
     name: str = "rmsprop"
     alpha: float = 0.99
     eps: float = 1e-8
+    uses_betas = False
 
     def init(self, params) -> OptimizerState:
         return OptimizerState(step=jnp.zeros((), jnp.int32), m=None,
@@ -318,6 +325,7 @@ class Adagrad(Optimizer):
     ``v += g^2; p -= lr * g / (sqrt(v) + eps)``."""
     name: str = "adagrad"
     eps: float = 1e-10
+    uses_betas = False
 
     def init(self, params) -> OptimizerState:
         return OptimizerState(step=jnp.zeros((), jnp.int32), m=None,

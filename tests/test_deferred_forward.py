@@ -1,0 +1,204 @@
+"""Deferred train-mode forward + five-span wall-clock breakdown.
+
+The engine fuses fwd+bwd into one XLA program dispatched at backward()
+(reference deepspeed_light.py:603-696 keeps them separate); these tests pin
+the user-visible contract of that design:
+  - a train-mode forward whose loss is never observed and never backward-ed
+    runs no model compute;
+  - materializing the lazy loss (float/np.asarray/jnp ops) yields the same
+    values as eager execution;
+  - wall_clock_breakdown exposes all five reference spans
+    (deepspeed_light.py:657-694).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.engine import (BACKWARD_INNER_TIMER, BACKWARD_REDUCE_TIMER,
+                                  BACKWARD_TIMER, FORWARD_TIMER, STEP_TIMER,
+                                  _DeferredLoss)
+
+from simple_model import SimpleModel
+
+pytestmark = pytest.mark.fast
+
+
+def random_batch(n, hidden, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hidden)).astype(np.float32)
+    y = rng.integers(0, hidden, size=(n,)).astype(np.int32)
+    return x, y
+
+
+def _config(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": True},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _engine(**over):
+    model = SimpleModel(hidden_dim=10)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=_config(**over), model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    return engine
+
+
+def test_forward_defers_model_compute():
+    engine = _engine()
+    batch = random_batch(8, 10, seed=0)
+    loss = engine(*batch)
+    # nothing has executed yet: the pending step is recorded, not forced
+    assert isinstance(loss, _DeferredLoss)
+    assert engine._pending is not None and not engine._pending.forced
+    assert engine._cached_grads is None
+    # backward() forces exactly one fused program and consumes the pending
+    engine.backward(loss)
+    assert engine._pending is None
+    engine.step()
+
+
+def test_unobserved_forward_costs_nothing():
+    engine = _engine()
+    # one full step first so the fused program is built, then count its calls
+    loss = engine(*random_batch(8, 10, seed=9))
+    engine.backward(loss)
+    engine.step()
+    calls = []
+    orig = engine._fwdbwd_fn
+    engine._fwdbwd_fn = lambda *a: calls.append(1) or orig(*a)
+    first = engine(*random_batch(8, 10, seed=0))
+    del first  # never materialized, never backward-ed → must never run
+    loss = engine(*random_batch(8, 10, seed=1))
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 2
+    assert len(calls) == 1  # only the observed forward executed
+
+
+def test_abandoned_but_held_loss_forced_before_step():
+    """A loss object the user still holds must be computed against the
+    params that were live when its forward was issued — step() forces it
+    before mutating params."""
+    # fp32: fp16's dynamic loss scale skips the first steps (overflow probe),
+    # which would leave params unchanged and defeat the comparison below
+    engine = _engine(fp16={"enabled": False})
+    batch = random_batch(8, 10, seed=0)
+    held = engine(*batch)  # same batch, never backward-ed
+    loss = engine(*batch)
+    engine.backward(loss)
+    engine.step()  # forces `held` against pre-step params
+    # post-step params differ, so a fresh forward on the same batch would
+    # give a different loss; `held` must equal the pre-step value
+    assert float(held) == pytest.approx(float(loss), rel=1e-6)
+    after = engine(*batch)
+    engine.backward(after)
+    engine.step()
+    assert float(held) != pytest.approx(float(after), rel=1e-9)
+
+
+def test_eval_forward_preserves_train_pending():
+    """Probing a validation loss between a train forward and its backward
+    must not drop the pending train step (eager design kept cached grads
+    across an interleaved eval forward)."""
+    engine = _engine()
+    batch = random_batch(8, 10, seed=0)
+    loss = engine(*batch)
+    engine.eval()
+    val = engine(*random_batch(8, 10, seed=1))
+    assert float(val) > 0.0
+    engine.train()
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 1
+
+
+def test_stale_loss_materialization_does_not_poison_grads():
+    """Materializing a superseded held loss must not re-arm backward() with
+    its gradients."""
+    engine = _engine()
+    batch = random_batch(8, 10, seed=0)
+    held = engine(*batch)  # superseded below, never backward-ed
+    loss = engine(*random_batch(8, 10, seed=1))
+    engine.backward(loss)
+    float(held)  # forces the stale pending — must NOT cache its grads
+    assert engine._cached_grads is None
+    with pytest.raises(AssertionError):
+        engine.backward()  # no forward since the last backward
+    engine.step()
+
+
+def test_lazy_loss_comparisons():
+    engine = _engine()
+    loss = engine(*random_batch(8, 10, seed=0))
+    v = float(jnp.asarray(loss))
+    assert bool(loss == v) and not bool(loss != v)
+    assert bool(loss < v + 1.0) and bool(loss > v - 1.0)
+    assert bool(loss <= v) and bool(loss >= v)
+    engine.backward(loss)
+    engine.step()
+
+
+def test_lazy_loss_matches_eager_value():
+    e_lazy = _engine()
+    e_ref = _engine()
+    for seed in range(3):
+        batch = random_batch(8, 10, seed=seed)
+        lazy = e_lazy(*batch)
+        ref = e_ref(*batch)
+        # materialize BEFORE backward on one engine, after on the other
+        lv = float(lazy)
+        e_lazy.backward(lazy)
+        e_lazy.step()
+        e_ref.backward(ref)
+        e_ref.step()
+        rv = float(ref)
+        assert lv == pytest.approx(rv, rel=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(e_lazy.params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(e_ref.params)[0]))
+
+
+def test_lazy_loss_materialization_protocols():
+    engine = _engine()
+    loss = engine(*random_batch(8, 10, seed=0))
+    assert np.asarray(loss).shape == ()
+    assert jnp.asarray(loss).shape == ()
+    assert isinstance(float(loss), float)
+    assert float(loss + 0.0) == float(loss)
+    assert float(2.0 * loss) == pytest.approx(2.0 * float(loss))
+    assert f"{loss}" == f"{jnp.asarray(loss)}"
+    assert loss.shape == ()  # attribute delegation
+    engine.backward(loss)
+    engine.step()
+
+
+def test_five_span_breakdown():
+    engine = _engine(wall_clock_breakdown=True)
+    for seed in range(2):
+        batch = random_batch(8, 10, seed=seed)
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+    names = (FORWARD_TIMER, BACKWARD_TIMER, BACKWARD_INNER_TIMER,
+             BACKWARD_REDUCE_TIMER, STEP_TIMER)
+    for name in names:
+        assert name in engine.timers.timers, f"span {name} never created"
+    # read the spans between backward and step (step()'s periodic log resets
+    # them); the fused fwd+bwd program executes under backward_inner
+    engine2 = _engine(wall_clock_breakdown=True)
+    loss = engine2(*random_batch(8, 10, seed=0))
+    engine2.backward(loss)
+    inner = engine2.timers(BACKWARD_INNER_TIMER).elapsed(reset=False)
+    assert inner > 0.0
+    engine2.step()

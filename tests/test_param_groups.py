@@ -136,6 +136,13 @@ def test_unsupported_group_keys_rejected():
                                    "momentum": 0.5}])
 
 
+def test_betas_rejected_for_betaless_optimizer():
+    """SGD/RMSprop/Adagrad never read beta1/beta2; a group carrying 'betas'
+    would display hyperparameters the update rule ignores."""
+    with pytest.raises(DeepSpeedConfigError, match="does not consume betas"):
+        make_engine(param_groups=[{"params": "head", "betas": (0.5, 0.9)}])
+
+
 def test_per_group_weight_decay():
     """Decay-excluded group (the published BERT recipe shape: LayerNorm/bias
     at weight_decay=0, reference bert-pretraining.md:289-305)."""
