@@ -275,18 +275,24 @@ def _save_zero_checkpoint(engine, save_dir: str, tag: str) -> None:
     padding is dropped so restores re-pad for their own topology."""
     meta = engine.flat_meta
     dp = engine.dp_world_size
+    # parameter-parallel sub-groups (parameter_parallel_size < dp) tile the
+    # flat buffer: only the first sub-group's partitions are distinct
+    parts = engine.zero_pps
     part = meta.partition
     masters = _flat_partitions(engine.master_flat, part)
     ms = _flat_partitions(engine.opt_state.m["flat"], part)
     vs = _flat_partitions(engine.opt_state.v["flat"], part)
     step = np.asarray(engine.opt_state.step)
     for (m, r), master in masters.items():
+        if r >= parts:
+            continue  # replica of partition r % parts
         lo = r * part
         count = int(np.clip(meta.total - lo, 0, part))
         shard = {
             "partition_id": r,
             "mp_rank": m,
             "dp_world_size": dp,
+            "partition_count": parts,
             "mp_world_size": engine.mp_world_size,
             "unpadded_total": meta.total,
             "step": step,
@@ -395,7 +401,8 @@ def _rederive_masters(engine) -> None:
     if engine.zero_enabled and engine.mp_world_size > 1:
         engine.master_flat = engine._flatten_masters_2d(masters)
     elif engine.zero_enabled:
-        flat = zero_mod.flatten_tree(masters, engine.flat_meta)
+        flat = engine._tile_flat(
+            zero_mod.flatten_tree(masters, engine.flat_meta))
         engine.master_flat = jax.device_put(flat,
                                             engine.master_flat.sharding)
     else:
@@ -431,9 +438,11 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
             f"engine has {mp}: ZeRO flat partitions are per-model-shard and "
             f"cannot be re-split (load with load_optimizer_states=False for "
             f"a weights-only restore)")
-    # trust the recorded dp_world_size, not directory probing — stale shards
-    # from an earlier save of the same tag under a larger dp must be ignored
-    saved_dp = int(shard0["dp_world_size"])
+    # trust the recorded partition count, not directory probing — stale
+    # shards from an earlier save of the same tag under a larger dp must be
+    # ignored (partition_count < dp_world_size when the save side used
+    # parameter_parallel_size sub-groups)
+    saved_dp = int(shard0.get("partition_count", shard0["dp_world_size"]))
     total = int(shard0["unpadded_total"])
     if total != meta.total:
         raise ValueError(
@@ -453,7 +462,7 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
 
     def stack(key):
         if mp == 1:
-            return reassemble(key, 0)
+            return engine._tile_flat(reassemble(key, 0))
         return np.stack([reassemble(key, m) for m in range(mp)])
 
     host_master = stack("master")

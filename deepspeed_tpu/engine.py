@@ -421,17 +421,33 @@ class DeepSpeedTpuEngine:
                     f"optimizers, got {self.base_optimizer.name!r} "
                     f"(reference guard: deepspeed_light.py:450-457)")
             # parameter-parallel sub-groups (reference deepspeed_light.py:
-            # 63-77) partition optimizer state over a SUBSET of the DP group;
-            # under GSPMD the partition axis is the mesh's data axis, so only
-            # the full-DP grouping is expressible — reject anything else
-            # loudly rather than silently ignoring the knob
+            # 63-77): optimizer state partitions over a SUBSET of size pps
+            # within the DP group, replicated across the dp/pps sub-groups.
+            # Layout: the flat master is [repl * padded] sharded P('data') —
+            # consecutive blocks of pps devices each hold the full
+            # partitioned state, exactly the reference's sub-group
+            # arrangement; collectives use axis_index_groups (reduce-scatter
+            # within the sub-group, psum across sub-groups, weight gather
+            # within the sub-group)
             pps = self.config.zero_parameter_parallel_size
-            if pps not in (None, 0) and int(pps) != self.dp_world_size:
+            if pps in (None, 0):
+                pps = self.dp_world_size
+            pps = int(pps)
+            if pps <= 0 or self.dp_world_size % pps != 0:
                 raise DeepSpeedConfigError(
-                    f"zero_optimization.parameter_parallel_size={pps} is not "
-                    f"supported: optimizer state partitions over the full "
-                    f"data axis (size {self.dp_world_size}); omit the knob "
-                    f"or set it to the DP world size")
+                    f"zero_optimization.parameter_parallel_size={pps} must "
+                    f"divide the DP world size ({self.dp_world_size})")
+            if pps != self.dp_world_size and self.mp_world_size > 1:
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.parameter_parallel_size={pps} with "
+                    f"model parallelism is not supported: the [mp, local] "
+                    f"flat layout partitions over the full DP group (omit "
+                    f"the knob or set it to {self.dp_world_size})")
+            self.zero_pps = pps
+            self.zero_repl = self.dp_world_size // pps
+        else:
+            self.zero_pps = self.dp_world_size
+            self.zero_repl = 1
 
         # -- loss scale state
         if self.config.fp16_enabled:
@@ -645,8 +661,13 @@ class DeepSpeedTpuEngine:
                     self.mp_world_size)),
                 self._named(P(DATA_AXIS)))
         elif self.zero_enabled:
-            self.flat_meta = zero_mod.make_flat_meta(masters, self.dp_world_size)
-            flat = zero_mod.flatten_tree(masters, self.flat_meta)
+            # partitions align to zero_pps (== dp unless
+            # parameter_parallel_size shrinks the partition group); with
+            # sub-groups the flat buffer is tiled repl× so each consecutive
+            # block of pps devices holds the full partitioned state
+            self.flat_meta = zero_mod.make_flat_meta(masters, self.zero_pps)
+            flat = self._tile_flat(zero_mod.flatten_tree(masters,
+                                                         self.flat_meta))
             self.master_flat = jax.device_put(flat, self._named(P(DATA_AXIS)))
             self.master = None
             self._zero_norm_w = None
@@ -668,6 +689,21 @@ class DeepSpeedTpuEngine:
         self.params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x, cdt), self._named(s)),
             model_parameters, self._param_specs)
+
+    def _tile_flat(self, flat):
+        """Replicate a [padded] flat buffer into the parameter-parallel
+        block-tiled [repl * padded] layout (no-op at full-DP partitioning).
+        Single owner of the sub-group layout invariant; inverse:
+        ``_untile_flat``."""
+        if self.zero_repl <= 1:
+            return flat
+        xp = np if isinstance(flat, np.ndarray) else jnp
+        return xp.tile(flat, self.zero_repl)
+
+    def _untile_flat(self, flat):
+        """First replica block of the block-tiled flat buffer (no-op at
+        full-DP partitioning)."""
+        return flat[:self.flat_meta.padded]
 
     def _flatten_masters_2d(self, masters):
         """Build the [mp, local_padded] P(model, data) flat master: each
@@ -1219,6 +1255,7 @@ class DeepSpeedTpuEngine:
         zero = self.zero_enabled
         mp = self.mp_world_size
         zero_2d = zero and mp > 1
+        pps = self.zero_pps
         cdt = self.policy.compute_dtype
         meta = self.flat_meta
         sparse_flags = self._sparse_flags
@@ -1251,7 +1288,8 @@ class DeepSpeedTpuEngine:
                     flat_local, DATA_AXIS, world,
                     fp32_allreduce=cfg.fp32_allreduce,
                     prescale_gradients=cfg.prescale_gradients,
-                    gradient_predivide_factor=cfg.gradient_predivide_factor)
+                    gradient_predivide_factor=cfg.gradient_predivide_factor,
+                    partition_group_size=pps)
                 overflow = comm.overflow_any(
                     jnp.logical_not(jnp.all(jnp.isfinite(gpart))), DATA_AXIS)
                 if zero_2d:
@@ -1264,9 +1302,16 @@ class DeepSpeedTpuEngine:
                     # (reference deepspeed_utils.py:100-158)
                     sq = jnp.sum(normw * gpart.astype(jnp.float32) ** 2)
                     sq = jax.lax.psum(jax.lax.psum(sq, DATA_AXIS), MODEL_AXIS)
-                else:
+                elif pps == world:
                     sq = jax.lax.psum(
                         jnp.sum(gpart.astype(jnp.float32) ** 2), DATA_AXIS)
+                else:
+                    # sub-partitions replicate across the dp/pps sub-groups;
+                    # sum within ONE sub-group to count each element once
+                    within, _ = comm.subgroup_index_groups(world, pps)
+                    sq = jax.lax.psum(
+                        jnp.sum(gpart.astype(jnp.float32) ** 2), DATA_AXIS,
+                        axis_index_groups=within)
                 total_norm = jnp.sqrt(sq)
                 combined = prec.combined_unscale_and_clip_factor(
                     total_norm, ls_state, clip) if fp16 else (
@@ -1288,7 +1333,8 @@ class DeepSpeedTpuEngine:
                         new_opt, opt_in)
                 # weight all-gather (reference zero_optimizer.py:397-432)
                 flat_full = comm.allgather_params(
-                    new_master.astype(jnp.float32), DATA_AXIS)
+                    new_master.astype(jnp.float32), DATA_AXIS,
+                    world_size=world, partition_group_size=pps)
                 params = zero_mod.unflatten_tree(flat_full, meta, dtype=cdt)
                 if zero_2d:
                     new_master = new_master[None]
@@ -1733,7 +1779,10 @@ class DeepSpeedTpuEngine:
             tree = zero_mod.combine_local_trees(rows, self._param_specs,
                                                 MODEL_AXIS)
         else:
-            tree = zero_mod.unflatten_tree(jnp.asarray(flat), self.flat_meta)
+            # parameter-parallel sub-groups tile the buffer repl×; every
+            # block holds the same values — unflatten the first
+            tree = zero_mod.unflatten_tree(
+                jnp.asarray(self._untile_flat(flat)), self.flat_meta)
         return jax.tree_util.tree_map(
             lambda x, s: jax.device_put(
                 jnp.asarray(x, self.policy.compute_dtype), self._named(s)),

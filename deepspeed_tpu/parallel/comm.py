@@ -82,35 +82,81 @@ def allreduce_grads(grads,
     return _tree_map(reduce_one, grads)
 
 
+def subgroup_index_groups(world_size: int, group_size: int):
+    """Axis-index groups for ZeRO parameter-parallel sub-groups (reference
+    deepspeed_light.py:63-77 builds the analogous torch process groups):
+
+      * ``within``: consecutive blocks of ``group_size`` ranks — the
+        partition owners (``[[0..g-1], [g..2g-1], ...]``).
+      * ``across``: ranks holding the SAME sub-partition in different
+        blocks (``[[p, p+g, p+2g, ...] for p in range(g)]``).
+    """
+    repl = world_size // group_size
+    within = [list(range(b * group_size, (b + 1) * group_size))
+              for b in range(repl)]
+    across = [[p + b * group_size for b in range(repl)]
+              for p in range(group_size)]
+    return within, across
+
+
 def reduce_scatter_grads(flat_grad: jnp.ndarray,
                          axis_name: str,
                          world_size: int,
                          fp32_allreduce: bool = False,
                          prescale_gradients: bool = False,
-                         gradient_predivide_factor: float = 1.0) -> jnp.ndarray:
+                         gradient_predivide_factor: float = 1.0,
+                         partition_group_size: Optional[int] = None
+                         ) -> jnp.ndarray:
     """Reduce-scatter a flat gradient over the DP axis, returning this rank's
-    partition (flat_grad length must be divisible by world_size).
+    partition (flat_grad length must be divisible by the partition group).
 
     The reference's ZeRO-1 reduces the *full* grad then frees non-owned slices
     (zero_optimizer.py:370-384); the reduce-scatter formulation moves half the
     bytes and was the reference's own roadmap item
     (docs/_posts/2020-03-17-reduce-scatter.md).  Same scaling knobs as
     ``allreduce_grads``.
+
+    With ``partition_group_size`` g < world (ZeRO parameter_parallel_size,
+    reference deepspeed_light.py:63-77) the scatter runs within each
+    consecutive g-rank sub-group and the partial sums then psum across
+    sub-groups, so every rank ends with the FULL-DP-reduced gradient of its
+    sub-partition (replicated across the world/g sub-groups).
     """
+    if partition_group_size is None or partition_group_size == world_size:
+        reduce_fn = lambda x: lax.psum_scatter(
+            x, axis_name, scatter_dimension=0, tiled=True)
+    else:
+        within, across = subgroup_index_groups(world_size,
+                                               partition_group_size)
+
+        def reduce_fn(x):
+            part = lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                    tiled=True, axis_index_groups=within)
+            return lax.psum(part, axis_name, axis_index_groups=across)
+
     return scaled_reduce(
         flat_grad,
-        lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=0,
-                                   tiled=True),
+        reduce_fn,
         world_size,
         fp32_allreduce=fp32_allreduce,
         prescale_gradients=prescale_gradients,
         gradient_predivide_factor=gradient_predivide_factor)
 
 
-def allgather_params(partition: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+def allgather_params(partition: jnp.ndarray, axis_name: str,
+                     world_size: Optional[int] = None,
+                     partition_group_size: Optional[int] = None
+                     ) -> jnp.ndarray:
     """Gather updated weight partitions from all DP ranks (flat, tiled) —
-    the ZeRO-1 weight allgather (reference zero_optimizer.py:397-432)."""
-    return lax.all_gather(partition, axis_name, axis=0, tiled=True)
+    the ZeRO-1 weight allgather (reference zero_optimizer.py:397-432).
+    With ``partition_group_size`` the gather stays within each sub-group
+    (each block of g ranks already holds all g sub-partitions)."""
+    if (partition_group_size is None or world_size is None
+            or partition_group_size == world_size):
+        return lax.all_gather(partition, axis_name, axis=0, tiled=True)
+    within, _ = subgroup_index_groups(world_size, partition_group_size)
+    return lax.all_gather(partition, axis_name, axis=0, tiled=True,
+                          axis_index_groups=within)
 
 
 def overflow_any(local_overflow, axis_name: Optional[str]):

@@ -21,9 +21,12 @@ tests/unit/test_fp16.py:320-347) is handled by the padding: ranks beyond the
 real parameter count own pure padding and the gather discards it.
 
 ``parameter_parallel_size`` sub-groups (reference deepspeed_light.py:63-77)
-and the ``allgather_size`` chunking knob (:399-425) are accepted in config;
-under XLA the gather schedule is the compiler's, so chunking is a no-op —
-kept as documented escape hatches.
+partition over a SUBSET of DP: the flat buffer is tiled ``dp/pps`` times into
+``[repl * padded]`` P('data') so each consecutive block of pps devices holds
+the full partitioned state, with ``axis_index_groups`` collectives
+(engine._make_step_local / parallel.comm).  The ``allgather_size`` chunking
+knob (:399-425) is accepted in config; under XLA the gather schedule is the
+compiler's, so chunking is a no-op — kept as a documented escape hatch.
 """
 
 from __future__ import annotations

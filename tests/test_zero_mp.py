@@ -7,7 +7,8 @@ deepspeed_light.py:63-77, _configure_zero_optimizer :520-531).  Here the same
 layout is the [mp, local_padded] P('model','data') flat master; these tests
 pin the semantics: identical trajectories to the non-ZeRO and mp=1 engines,
 agreed overflow/clip decisions across shards, and a loud reject of
-parameter-parallel sub-groups GSPMD cannot express.
+parameter-parallel sub-groups combined with MP (sub-groups under pure DP
+are supported — tests/test_zero_pps.py).
 """
 
 import jax
