@@ -41,9 +41,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu import zero as zero_mod
-from deepspeed_tpu.parallel.topology import MODEL_AXIS
+from deepspeed_tpu.parallel.topology import MODEL_AXIS, PIPE_AXIS
 
 MODEL_FILE = "mp_rank_{mp:02d}_model_states.pt"
+# pipeline stages get their own model-state files (generalizing the
+# reference's per-MP-rank layout rule, deepspeed_light.py:949-967)
+MODEL_FILE_PP = "pp_stage_{pp:02d}_mp_rank_{mp:02d}_model_states.pt"
 ZERO_FILE = "zero_pp_rank_{dp}_mp_rank_{mp:02d}optim_states.pt"
 LATEST_FILE = "latest"
 
@@ -93,7 +96,11 @@ def _load_obj(path: str) -> Any:
         return _RestrictedUnpickler(f).load()
 
 
-def model_file(ckpt_dir: str, tag: str, mp_rank: int = 0) -> str:
+def model_file(ckpt_dir: str, tag: str, mp_rank: int = 0,
+               pp_stage: int = 0, pp_size: int = 1) -> str:
+    if pp_size > 1:
+        return os.path.join(ckpt_dir, tag,
+                            MODEL_FILE_PP.format(pp=pp_stage, mp=mp_rank))
     return os.path.join(ckpt_dir, tag, MODEL_FILE.format(mp=mp_rank))
 
 
@@ -102,64 +109,147 @@ def zero_file(ckpt_dir: str, tag: str, dp_rank: int, mp_rank: int = 0) -> str:
                         ZERO_FILE.format(dp=dp_rank, mp=mp_rank))
 
 
-# --------------------------------------------------------- per-MP-rank split
+# ------------------------------------------- per-(pp stage, mp rank) split
 
-def _model_dim(spec) -> Optional[int]:
+def _axis_dim(spec, axis: str) -> Optional[int]:
     for d, entry in enumerate(spec):
         if entry is None:
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
-        if MODEL_AXIS in axes:
+        if axis in axes:
             return d
     return None
 
 
-def _collect_mp_states(tree, specs, mp_size: int):
-    """Split a sharded pytree into per-model-rank local trees using ONLY
+def _rank_owners(mesh, axes):
+    """Writer process for each composite rank: the process holding the mesh
+    device at (rank's axis coordinates, every other axis 0).  Deterministic
+    and communication-free — unlike replica-id probing, it cannot leave a
+    rank ownerless when its sharded leaves' replica-0 copies straddle hosts
+    (pipe-sharded blocks on one host, pipe-replicated embeddings on
+    another)."""
+    names = list(mesh.axis_names)
+    sizes = [n for _, n in axes]
+    S = 1
+    for n in sizes:
+        S *= n
+    owners = []
+    for r in range(S):
+        rem, comps = r, []
+        for n in reversed(sizes):
+            rem, c = divmod(rem, n)
+            comps.insert(0, c)
+        idx = [0] * len(names)
+        for (name, _), c in zip(axes, comps):
+            if name in names:
+                idx[names.index(name)] = c
+        owners.append(int(mesh.devices[tuple(idx)].process_index))
+    return owners
+
+
+def _collect_shard_states(tree, specs, axes, mesh=None):
+    """Split a sharded pytree into per-composite-rank local trees using ONLY
     this process's addressable shards (multi-host safe: nothing is gathered).
 
-    Returns ``(local_trees, owned)``: ``local_trees[m]`` is rank m's local
-    slice tree (leaves this process cannot see are None) and ``owned[m]``
-    says whether this process holds the replica-0 copy of every
-    model-sharded leaf of rank m — the write-role rule (the reference's
-    "dp rank 0 of each MP group saves", deepspeed_light.py:329-343)."""
+    ``axes`` is ``[(axis_name, size), ...]`` (row-major: first axis is the
+    slowest-varying component of the composite rank — pipe before model).
+    Returns ``(local_trees, owned)``: ``local_trees[r]`` is composite rank
+    r's local slice tree (leaves this process cannot see are None) and
+    ``owned[r]`` says whether this process is rank r's writer — the
+    write-role rule (the reference's "dp rank 0 of each MP group saves",
+    deepspeed_light.py:329-343).  With ``mesh`` the role comes from
+    ``_rank_owners`` (multi-host safe for composite ranks); without it,
+    from holding the replica-0 copy of every sharded leaf."""
+    sizes = [n for _, n in axes]
+    S = 1
+    for n in sizes:
+        S *= n
+    strides = []
+    acc = 1
+    for n in reversed(sizes):
+        strides.insert(0, acc)
+        acc *= n
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     spec_leaves = treedef.flatten_up_to(specs)
-    per_rank = [[None] * len(leaves) for _ in range(mp_size)]
-    owned = [True] * mp_size
+    per_rank = [[None] * len(leaves) for _ in range(S)]
+    owned = [True] * S
     any_sharded = False
+
+    def ranks_for(comps):
+        """Composite ranks a shard with per-axis components ``comps``
+        (None = replicated over that axis → all positions) belongs to."""
+        ranks = [0]
+        for k, c in enumerate(comps):
+            if c is None:
+                ranks = [r + j * strides[k] for r in ranks
+                         for j in range(sizes[k])]
+            else:
+                ranks = [r + c * strides[k] for r in ranks]
+        return ranks
+
     for i, (leaf, spec) in enumerate(zip(leaves, spec_leaves)):
-        d = _model_dim(spec)
-        if d is None or mp_size == 1:
-            # replicated over the model axis: addressable on every device
+        dims = [_axis_dim(spec, name) for name, _ in axes]
+        if all(d is None for d in dims) or S == 1:
+            # replicated over every state axis: addressable everywhere
             val = np.asarray(leaf.addressable_shards[0].data)
-            for m in range(mp_size):
-                per_rank[m][i] = val
-        else:
-            any_sharded = True
-            local = leaf.shape[d] // mp_size
-            seen = {}
-            for s in leaf.addressable_shards:
-                m = (s.index[d].start or 0) // local
-                if m not in seen or s.replica_id == 0:
-                    seen[m] = (s, s.replica_id == 0)
-            for m in range(mp_size):
-                if m in seen:
-                    per_rank[m][i] = np.asarray(seen[m][0].data)
-                    owned[m] = owned[m] and seen[m][1]
+            for r in range(S):
+                per_rank[r][i] = val
+            continue
+        any_sharded = True
+        seen = {}
+        for s in leaf.addressable_shards:
+            comps = []
+            for k, d in enumerate(dims):
+                if d is None:
+                    comps.append(None)
                 else:
-                    owned[m] = False
-    if not any_sharded:
-        owned = [jax.process_index() == 0] * mp_size
-    trees = [treedef.unflatten(per_rank[m]) for m in range(mp_size)]
+                    local = leaf.shape[d] // sizes[k]
+                    comps.append((s.index[d].start or 0) // local)
+            for r in ranks_for(comps):
+                if r not in seen or s.replica_id == 0:
+                    seen[r] = (s, s.replica_id == 0)
+        for r in range(S):
+            if r in seen:
+                per_rank[r][i] = np.asarray(seen[r][0].data)
+                owned[r] = owned[r] and seen[r][1]
+            else:
+                owned[r] = False
+    if mesh is not None:
+        me = jax.process_index()
+        owners = _rank_owners(mesh, axes)
+        owned = [owners[r] == me for r in range(S)]
+        for r in range(S):
+            if owned[r] and any(v is None for v in per_rank[r]):
+                raise RuntimeError(
+                    f"checkpoint write role for composite rank {r} assigned "
+                    f"to process {me} but some leaves are not addressable "
+                    f"here — mesh/process layout mismatch")
+    elif not any_sharded:
+        owned = [jax.process_index() == 0] * S
+    trees = [treedef.unflatten(per_rank[r]) for r in range(S)]
     return trees, owned
 
 
-def _combine_mp_states(local_trees, specs):
-    """Inverse of ``_collect_mp_states`` on the host: one global np tree."""
-    if len(local_trees) == 1:
-        return local_trees[0]
-    return zero_mod.combine_local_trees(local_trees, specs, MODEL_AXIS)
+def _combine_shard_states(local_trees, specs, axes):
+    """Inverse of ``_collect_shard_states`` on the host: one global np tree.
+    Combines the innermost axis first (rank = outer * inner_size + inner)."""
+    return zero_mod.combine_composite_trees(local_trees, specs, axes)
+
+
+def _state_axes(pp_size: int, mp_size: int):
+    """The composite split used for model-state files: pipe major, model
+    minor; at least one axis so the rank-0 path is uniform."""
+    axes = []
+    if pp_size > 1:
+        axes.append((PIPE_AXIS, pp_size))
+    axes.append((MODEL_AXIS, mp_size))
+    return axes
+
+
+def _collect_mp_states(tree, specs, mp_size: int):
+    """Model-axis-only split (multi-process write-role tests exercise this
+    directly; the engine paths use the composite _collect_shard_states)."""
+    return _collect_shard_states(tree, specs, [(MODEL_AXIS, mp_size)])
 
 
 # ------------------------------------------------------------------- saving
@@ -167,15 +257,13 @@ def _combine_mp_states(local_trees, specs):
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None) -> str:
     """Engine-level save (reference save_checkpoint :1048-1114)."""
-    if getattr(engine, "pp_world_size", 1) > 1:
-        raise NotImplementedError(
-            "checkpointing with pipeline_parallel_size > 1 is not supported "
-            "yet: pipe-sharded layer stacks need per-stage files")
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
 
     mp = engine.mp_world_size
+    pp = getattr(engine, "pp_world_size", 1)
+    axes = _state_axes(pp, mp)
     scalar_state = {
         "loss_scale_state": _to_np(engine.loss_scale_state._asdict()),
         "loss_scale_variant": engine._ls_variant,
@@ -191,42 +279,50 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "micro_steps": engine.micro_steps,
         "zero_enabled": engine.zero_enabled,
         "mp_world_size": mp,
+        "pp_world_size": pp,
         "client_state": dict(client_state or {}),
     }
 
-    params_mp, owned = _collect_mp_states(engine.params, engine._param_specs,
-                                          mp)
+    S = pp * mp
+    params_s, owned = _collect_shard_states(engine.params,
+                                            engine._param_specs, axes,
+                                            mesh=engine.mesh)
     if engine.zero_enabled:
         # three SEPARATE lists: masters live in ZeRO files, and sharing one
         # list object would make any future in-place write corrupt all three
-        master_mp, m_mp, v_mp = ([None] * mp for _ in range(3))
+        master_s, m_s, v_s = ([None] * S for _ in range(3))
         step_np = None
     else:
-        master_mp, _ = _collect_mp_states(engine.master, engine._param_specs,
-                                          mp)
-        m_mp = ([None] * mp if engine.opt_state.m is None else
-                _collect_mp_states(engine.opt_state.m,
-                                   engine._param_specs, mp)[0])
-        v_mp = ([None] * mp if engine.opt_state.v is None else
-                _collect_mp_states(engine.opt_state.v,
-                                   engine._param_specs, mp)[0])
+        master_s, _ = _collect_shard_states(engine.master,
+                                            engine._param_specs, axes,
+                                            mesh=engine.mesh)
+        m_s = ([None] * S if engine.opt_state.m is None else
+               _collect_shard_states(engine.opt_state.m,
+                                     engine._param_specs, axes,
+                                     mesh=engine.mesh)[0])
+        v_s = ([None] * S if engine.opt_state.v is None else
+               _collect_shard_states(engine.opt_state.v,
+                                     engine._param_specs, axes,
+                                     mesh=engine.mesh)[0])
         step_np = np.asarray(engine.opt_state.step)
 
-    for rank in range(mp):
+    for rank in range(S):
         if not owned[rank]:
-            continue                    # another process owns this MP shard
+            continue              # another process owns this stage/MP shard
+        stage, mp_rank = divmod(rank, mp)
         state = dict(scalar_state)
-        state["mp_rank"] = rank
-        state["module"] = params_mp[rank]
+        state["mp_rank"] = mp_rank
+        state["pp_stage"] = stage
+        state["module"] = params_s[rank]
         if engine.zero_enabled:
             state["optimizer"] = None
         else:
             state["optimizer"] = {
-                "master": master_mp[rank],
-                "opt_state": {"step": step_np, "m": m_mp[rank],
-                              "v": v_mp[rank]},
+                "master": master_s[rank],
+                "opt_state": {"step": step_np, "m": m_s[rank],
+                              "v": v_s[rank]},
             }
-        _save_obj(model_file(save_dir, tag, rank), state)
+        _save_obj(model_file(save_dir, tag, mp_rank, stage, pp), state)
 
     if engine.save_zero_checkpoint:
         _save_zero_checkpoint(engine, save_dir, tag)
@@ -237,10 +333,25 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # see a tag whose zero_pp_rank_* shards are still being written
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"dstpu_ckpt_{tag}")
+        multihost_utils.sync_global_devices(f"dstpu_ckpt_{tag}_written")
     if jax.process_index() == 0:
+        # drop model-state files left by an earlier save of the SAME tag
+        # under a different topology (pp=1's mp_rank_* vs pp>1's
+        # pp_stage_* names) — a reader following `latest` must never pick
+        # up a stale file (the zero shards handle the same hazard via
+        # partition_count)
+        expected = {os.path.basename(model_file(save_dir, tag,
+                                                r % mp, r // mp, pp))
+                    for r in range(S)}
+        for f in os.listdir(path):
+            if f.endswith("_model_states.pt") and f not in expected:
+                os.remove(os.path.join(path, f))
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(tag)
+    # second barrier: by the time ANY process returns, the pointer is
+    # visible — tests/distributed/workers.py pins this contract
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(f"dstpu_ckpt_{tag}_published")
     return path
 
 
@@ -290,10 +401,11 @@ def _save_zero_checkpoint(engine, save_dir: str, tag: str) -> None:
         count = int(np.clip(meta.total - lo, 0, part))
         shard = {
             "partition_id": r,
-            "mp_rank": m,
+            "mp_rank": m,  # composite row id: pp_stage * mp + mp_rank
             "dp_world_size": dp,
             "partition_count": parts,
             "mp_world_size": engine.mp_world_size,
+            "pp_world_size": getattr(engine, "pp_world_size", 1),
             "unpadded_total": meta.total,
             "step": step,
             "master": master[:count],
@@ -319,17 +431,24 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     mfile = model_file(load_dir, tag, 0)
     if not os.path.exists(mfile):
-        return None, None
+        # pp>1 saves use per-stage file names
+        mfile = model_file(load_dir, tag, 0, 0, pp_size=2)
+        if not os.path.exists(mfile):
+            return None, None
     state = _load_obj(mfile)
     saved_mp = int(state.get("mp_world_size", 1))
-    states = [state] + [_load_obj(model_file(load_dir, tag, r))
-                        for r in range(1, saved_mp)]
+    saved_pp = int(state.get("pp_world_size", 1))
+    states = [state] + [
+        _load_obj(model_file(load_dir, tag, r % saved_mp, r // saved_mp,
+                             saved_pp))
+        for r in range(1, saved_pp * saved_mp)]
 
-    # module weights (compute dtype), reassembled from the per-MP-rank local
-    # slices and re-sharded for the CURRENT mesh — reference :995-1004
+    # module weights (compute dtype), reassembled from the per-stage/MP-rank
+    # local slices and re-sharded for the CURRENT mesh — reference :995-1004
     # (which requires the same MP degree; the reassembly lifts that)
-    module = _combine_mp_states([s["module"] for s in states],
-                                engine._param_specs)
+    saved_axes = _state_axes(saved_pp, saved_mp)
+    module = _combine_shard_states([s["module"] for s in states],
+                                   engine._param_specs, saved_axes)
     engine.params = jax.tree_util.tree_map(
         lambda old, new: jax.device_put(
             jnp.asarray(new, old.dtype), old.sharding),
@@ -366,15 +485,19 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 "engine has ZeRO off — enable zero_optimization, or pass "
                 "load_optimizer_states=False for a weights-only load")
         elif state.get("optimizer") is not None:
-            master = _combine_mp_states(
+            master = _combine_shard_states(
                 [s["optimizer"]["master"] for s in states],
-                engine._param_specs)
+                engine._param_specs, saved_axes)
             m_trees = [s["optimizer"]["opt_state"]["m"] for s in states]
             m_tree = (None if m_trees[0] is None
-                      else _combine_mp_states(m_trees, engine._param_specs))
+                      else _combine_shard_states(m_trees,
+                                                 engine._param_specs,
+                                                 saved_axes))
             v_trees = [s["optimizer"]["opt_state"]["v"] for s in states]
             v_tree = (None if v_trees[0] is None
-                      else _combine_mp_states(v_trees, engine._param_specs))
+                      else _combine_shard_states(v_trees,
+                                                 engine._param_specs,
+                                                 saved_axes))
             engine.master = jax.tree_util.tree_map(
                 lambda old, new: jax.device_put(
                     jnp.asarray(new, old.dtype), old.sharding),
@@ -398,7 +521,7 @@ def _rederive_masters(engine) -> None:
     """Rebuild fp32 masters (flat or per-leaf) from engine.params."""
     masters = jax.tree_util.tree_map(
         lambda p: jnp.asarray(p, jnp.float32), engine.params)
-    if engine.zero_enabled and engine.mp_world_size > 1:
+    if engine.zero_enabled and engine._zero_state_axes:
         engine.master_flat = engine._flatten_masters_2d(masters)
     elif engine.zero_enabled:
         flat = engine._tile_flat(
@@ -423,8 +546,10 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
     """Reassemble the flat fp32 master + moments from per-partition shards
     saved under ANY dp world size, re-pad for the current topology
     (reference _load_zero_checkpoint :1034-1046 requires matching topology;
-    we lift the DP restriction — MP must match, like the reference)."""
+    we lift the DP restriction — MP and PP must match, like the
+    reference)."""
     mp = engine.mp_world_size
+    pp = getattr(engine, "pp_world_size", 1)
     meta = engine.flat_meta
     first = zero_file(load_dir, tag, 0, 0)
     if not os.path.exists(first):
@@ -432,12 +557,14 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
             f"no zero checkpoint shards under {load_dir}/{tag}")
     shard0 = _load_obj(first)
     saved_mp = int(shard0.get("mp_world_size", 1))
-    if saved_mp != mp:
+    saved_pp = int(shard0.get("pp_world_size", 1))
+    if saved_mp != mp or saved_pp != pp:
         raise ValueError(
-            f"zero checkpoint was saved with model_parallel_size={saved_mp}, "
-            f"engine has {mp}: ZeRO flat partitions are per-model-shard and "
-            f"cannot be re-split (load with load_optimizer_states=False for "
-            f"a weights-only restore)")
+            f"zero checkpoint was saved with model_parallel_size="
+            f"{saved_mp}, pipeline_parallel_size={saved_pp}; engine has "
+            f"mp={mp}, pp={pp}: ZeRO flat partitions are per-stage/shard "
+            f"and cannot be re-split (load with "
+            f"load_optimizer_states=False for a weights-only restore)")
     # trust the recorded partition count, not directory probing — stale
     # shards from an earlier save of the same tag under a larger dp must be
     # ignored (partition_count < dp_world_size when the save side used
@@ -449,8 +576,9 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
             f"zero checkpoint has {total} elements, engine expects "
             f"{meta.total} (different model?)")
 
+    rows = pp * mp  # composite stage/rank rows of the [S, local] layout
     table = [[_load_obj(zero_file(load_dir, tag, r, m))
-              for r in range(saved_dp)] for m in range(mp)]
+              for r in range(saved_dp)] for m in range(rows)]
 
     def reassemble(key, m):
         flat = np.concatenate([np.asarray(s[key]) for s in table[m]])
@@ -461,9 +589,9 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
         return flat
 
     def stack(key):
-        if mp == 1:
+        if rows == 1:
             return engine._tile_flat(reassemble(key, 0))
-        return np.stack([reassemble(key, m) for m in range(mp)])
+        return np.stack([reassemble(key, m) for m in range(rows)])
 
     host_master = stack("master")
     engine.master_flat = jax.device_put(jnp.asarray(host_master),

@@ -409,11 +409,14 @@ class DeepSpeedTpuEngine:
         # -- ZeRO guard (reference restricts ZeRO to (fused) Adam,
         #    deepspeed_light.py:450-457 + _configure_zero_optimizer :520)
         self.zero_enabled = self.config.zero_enabled
-        if self.zero_enabled and self.pp_world_size > 1:
-            raise DeepSpeedConfigError(
-                "zero_optimization with pipeline_parallel_size > 1 is not "
-                "supported yet: the flat optimizer-state buffer would need "
-                "a per-pipe-stage layout")
+        # axes model STATE shards over beyond data: each (pipe stage, model
+        # rank) pair keeps a flat fp32 master of only ITS parameter slices,
+        # partitioned over its DP group (the [S, local_padded] layout)
+        self._zero_state_axes = []
+        if self.pp_world_size > 1:
+            self._zero_state_axes.append((PIPE_AXIS, self.pp_world_size))
+        if self.mp_world_size > 1:
+            self._zero_state_axes.append((MODEL_AXIS, self.mp_world_size))
         if self.zero_enabled:
             if self.base_optimizer.name not in ("adam", "adamw"):
                 raise DeepSpeedConfigError(
@@ -437,12 +440,13 @@ class DeepSpeedTpuEngine:
                 raise DeepSpeedConfigError(
                     f"zero_optimization.parameter_parallel_size={pps} must "
                     f"divide the DP world size ({self.dp_world_size})")
-            if pps != self.dp_world_size and self.mp_world_size > 1:
+            if pps != self.dp_world_size and self._zero_state_axes:
                 raise DeepSpeedConfigError(
                     f"zero_optimization.parameter_parallel_size={pps} with "
-                    f"model parallelism is not supported: the [mp, local] "
-                    f"flat layout partitions over the full DP group (omit "
-                    f"the knob or set it to {self.dp_world_size})")
+                    f"model/pipeline parallelism is not supported: the "
+                    f"[S, local] flat layout partitions over the full DP "
+                    f"group (omit the knob or set it to "
+                    f"{self.dp_world_size})")
             self.zero_pps = pps
             self.zero_repl = self.dp_world_size // pps
         else:
@@ -644,12 +648,13 @@ class DeepSpeedTpuEngine:
         to_f32 = lambda x: jnp.asarray(x, jnp.float32)
         masters = jax.tree_util.tree_map(to_f32, model_parameters)
 
-        if self.zero_enabled and self.mp_world_size > 1:
-            # ZeRO x MP: each model shard keeps a flat fp32 master of only
-            # ITS parameter slices, partitioned over its DP group (reference
-            # parameter-parallel groups, deepspeed_light.py:63-77 +
-            # _configure_zero_optimizer :520-531).  Layout: [mp, local_padded]
-            # sharded P(model, data) — row m is model shard m's flat buffer.
+        if self.zero_enabled and self._zero_state_axes:
+            # ZeRO x MP/PP: each (pipe stage, model rank) keeps a flat fp32
+            # master of only ITS parameter slices, partitioned over its DP
+            # group (reference parameter-parallel groups,
+            # deepspeed_light.py:63-77 + _configure_zero_optimizer
+            # :520-531).  Layout: [S, local_padded] sharded
+            # P((pipe, model), data) — row is the composite stage/rank id.
             self.flat_meta = zero_mod.make_local_flat_meta(
                 masters, self._param_specs, dict(self.mesh.shape),
                 self.dp_world_size)
@@ -657,8 +662,8 @@ class DeepSpeedTpuEngine:
             self.master = None
             self._zero_norm_w = jax.device_put(
                 jnp.asarray(zero_mod.norm_dedup_weights(
-                    self.flat_meta, self._param_specs, MODEL_AXIS,
-                    self.mp_world_size)),
+                    self.flat_meta, self._param_specs,
+                    self._zero_state_axes)),
                 self._named(P(DATA_AXIS)))
         elif self.zero_enabled:
             # partitions align to zero_pps (== dp unless
@@ -706,9 +711,10 @@ class DeepSpeedTpuEngine:
         return flat[:self.flat_meta.padded]
 
     def _flatten_masters_2d(self, masters):
-        """Build the [mp, local_padded] P(model, data) flat master: each
-        model shard flattens its local fp32 slices and keeps only its DP
-        partition (runs as one shard_mapped program, no host gather)."""
+        """Build the [S, local_padded] P((pipe, model), data) flat master
+        (S = pp * mp): each stage/model shard flattens its local fp32
+        slices and keeps only its DP partition (runs as one shard_mapped
+        program, no host gather)."""
         meta = self.flat_meta
         part = meta.partition
 
@@ -721,7 +727,7 @@ class DeepSpeedTpuEngine:
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._param_specs,),
-            out_specs=P(MODEL_AXIS, DATA_AXIS),
+            out_specs=self._zero_flat_spec(),
             check_vma=False)
         placed = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x, jnp.float32),
@@ -1254,7 +1260,8 @@ class DeepSpeedTpuEngine:
         variant = self._ls_variant
         zero = self.zero_enabled
         mp = self.mp_world_size
-        zero_2d = zero and mp > 1
+        state_axes = list(self._zero_state_axes)
+        zero_2d = zero and bool(state_axes)
         pps = self.zero_pps
         cdt = self.policy.compute_dtype
         meta = self.flat_meta
@@ -1293,15 +1300,19 @@ class DeepSpeedTpuEngine:
                 overflow = comm.overflow_any(
                     jnp.logical_not(jnp.all(jnp.isfinite(gpart))), DATA_AXIS)
                 if zero_2d:
-                    # every model shard must take the same skip decision
-                    # (reference MP-group MAX-reduce, deepspeed_utils.py:62-75)
-                    overflow = comm.overflow_any(overflow, MODEL_AXIS)
+                    # every stage/model shard must take the same skip
+                    # decision (reference MP-group MAX-reduce,
+                    # deepspeed_utils.py:62-75, generalized to the pipe axis)
+                    for ax, _ in state_axes:
+                        overflow = comm.overflow_any(overflow, ax)
                     # norm with replicated-leaf dedup: normw weights each
-                    # element 1 (model-sharded) or 1/mp (replicated), so the
-                    # model-axis psum counts every parameter exactly once
-                    # (reference deepspeed_utils.py:100-158)
+                    # element 1 (sharded) or 1/size per replicating axis, so
+                    # the state-axes psum counts every parameter exactly
+                    # once (reference deepspeed_utils.py:100-158)
                     sq = jnp.sum(normw * gpart.astype(jnp.float32) ** 2)
-                    sq = jax.lax.psum(jax.lax.psum(sq, DATA_AXIS), MODEL_AXIS)
+                    sq = jax.lax.psum(sq, DATA_AXIS)
+                    for ax, _ in state_axes:
+                        sq = jax.lax.psum(sq, ax)
                 elif pps == world:
                     sq = jax.lax.psum(
                         jnp.sum(gpart.astype(jnp.float32) ** 2), DATA_AXIS)
@@ -1402,10 +1413,13 @@ class DeepSpeedTpuEngine:
         return step_local
 
     def _zero_flat_spec(self):
-        """Sharding of the ZeRO flat master/moment buffers: [mp, local_padded]
-        over (model, data) when tensor parallel, 1-D over data otherwise."""
-        return (P(MODEL_AXIS, DATA_AXIS) if self.mp_world_size > 1
-                else P(DATA_AXIS))
+        """Sharding of the ZeRO flat master/moment buffers: [S, local_padded]
+        over ((pipe, model), data) when pipeline/tensor parallel, 1-D over
+        data otherwise."""
+        if self._zero_state_axes:
+            return P(tuple(name for name, _ in self._zero_state_axes),
+                     DATA_AXIS)
+        return P(DATA_AXIS)
 
     def _step_specs(self):
         """(master_spec, opt_spec, ls_spec) partition specs for the update."""
@@ -1776,8 +1790,11 @@ class DeepSpeedTpuEngine:
                 t = zero_mod.unflatten_tree(jnp.asarray(flat[r]),
                                             self.flat_meta)
                 rows.append(jax.tree_util.tree_map(np.asarray, t))
-            tree = zero_mod.combine_local_trees(rows, self._param_specs,
-                                                MODEL_AXIS)
+
+            # rows are pipe-major, model-minor — the [S, local] composite
+            # layout
+            tree = zero_mod.combine_composite_trees(
+                rows, self._param_specs, self._zero_state_axes)
         else:
             # parameter-parallel sub-groups tile the buffer repl×; every
             # block holds the same values — unflatten the first

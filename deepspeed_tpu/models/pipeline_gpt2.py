@@ -8,9 +8,10 @@ LayerNorm/head are replicated across stages; the loss is masked to the last
 stage and psum'd, so stage-replicated parameter gradients arrive as
 per-stage partial sums the engine completes over ``pipe``.
 
-Composes with tensor parallelism (blocks sharded over BOTH pipe and model)
-and data parallelism; ZeRO / context parallelism / checkpointing with pp>1
-are engine-guarded for now.
+Composes with tensor parallelism (blocks sharded over BOTH pipe and model),
+data parallelism, ZeRO-1 (per-stage [S, local] flat masters), and
+checkpointing (per-stage model files); context parallelism with pp>1 stays
+engine-guarded.
 """
 
 from __future__ import annotations

@@ -108,25 +108,48 @@ def make_local_flat_meta(params, specs, axis_sizes, dp_size: int,
     return _meta_from_shapes(treedef, shapes, dp_size, align)
 
 
-def norm_dedup_weights(meta: FlatMeta, specs, model_axis: str,
-                       mp_size: int) -> np.ndarray:
-    """Per-element weights so a model-axis psum of weighted squared norms
+def norm_dedup_weights(meta: FlatMeta, specs, state_axes) -> np.ndarray:
+    """Per-element weights so a state-axes psum of weighted squared norms
     counts every parameter exactly once (the reference's replicated-parameter
-    dedup, deepspeed_utils.py:100-158): model-sharded leaves contribute
-    distinct slices on every shard (weight 1), model-replicated leaves are
-    identical on every shard (weight 1/mp)."""
+    dedup, deepspeed_utils.py:100-158).  ``state_axes`` is a sequence of
+    ``(axis_name, size)`` — the model/pipe axes parameters may shard over:
+    leaves sharded over an axis contribute distinct slices on every shard
+    (weight factor 1), leaves replicated over it are identical on every
+    shard (factor 1/size); factors multiply across axes."""
     spec_leaves = meta.treedef.flatten_up_to(specs)
     pieces = []
     for spec, size in zip(spec_leaves, meta.sizes):
         axes = set()
         for entry in spec:
             axes.update(_spec_axes(entry))
-        w = 1.0 if model_axis in axes else 1.0 / mp_size
+        w = 1.0
+        for name, n in state_axes:
+            if name not in axes:
+                w /= n
         pieces.append(np.full((size,), w, np.float32))
     pad = meta.padded - meta.total
     if pad:
         pieces.append(np.zeros((pad,), np.float32))
     return np.concatenate(pieces)
+
+
+def combine_composite_trees(local_trees, specs, axes):
+    """Reassemble a global pytree from per-composite-rank local trees (host
+    side).  ``axes`` is ``[(axis_name, size), ...]`` row-major (first axis
+    slowest-varying — pipe before model); the innermost axis combines
+    first.  Single owner of the composite-rank ordering invariant shared by
+    checkpoint reassembly and engine._params_from_master_flat."""
+    if len(local_trees) == 1:
+        return local_trees[0]
+    if len(axes) == 1:
+        return combine_local_trees(local_trees, specs, axes[0][0])
+    inner = 1
+    for _, n in axes[1:]:
+        inner *= n
+    outer = [combine_composite_trees(local_trees[o * inner:(o + 1) * inner],
+                                     specs, axes[1:])
+             for o in range(axes[0][1])]
+    return combine_local_trees(outer, specs, axes[0][0])
 
 
 def combine_local_trees(local_trees, specs, model_axis: str):

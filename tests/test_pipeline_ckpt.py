@@ -1,0 +1,123 @@
+"""Pipeline parallelism × checkpointing × ZeRO-1.
+
+VERDICT r2 #4: per-stage checkpoint files (the mp_rank layout generalized to
+pp_stage, reference layout rule deepspeed_light.py:949-967), and the ZeRO
+flat master generalized to a per-(stage, model-rank) [S, local] layout so
+pp>1 composes with optimizer-state partitioning.
+
+Pinned semantics:
+  * ZeRO × pp=2 reproduces the non-ZeRO pp=2 trajectory;
+  * pp=2 train → save → fresh engine load → resume matches the unbroken
+    run (with and without ZeRO, and composed with mp=2);
+  * restoring ZeRO shards across a different pp degree fails loudly.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2Pipelined
+from deepspeed_tpu.parallel.topology import make_mesh
+
+pytestmark = pytest.mark.slow
+
+VOCAB, SEQ = 64, 16
+
+
+def lm_batch(batch, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, VOCAB, size=(batch, SEQ)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+    return toks, labels
+
+
+def make_engine(pp=2, mp=1, zero=False, **cfg_over):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 10 ** 6,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+    }
+    if zero:
+        cfg["zero_optimization"] = {"stage": 1}
+    cfg.update(cfg_over)
+    # pp=1 runs on a data-only mesh where the per-shard batch is 1
+    model = GPT2Pipelined.from_size(
+        "tiny", num_micro_batches=(2 if pp > 1 else 1), vocab_size=VOCAB,
+        max_seq_len=SEQ, num_layers=4, hidden_size=32, num_heads=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(7)),
+        mesh=make_mesh(pipeline_parallel_size=pp, model_parallel_size=mp))
+    return engine
+
+
+def train(engine, steps, seed0=0):
+    out = []
+    for i in range(steps):
+        toks, labels = lm_batch(8, seed=seed0 + i)
+        loss = engine(toks, labels)
+        engine.backward(loss)
+        engine.step()
+        out.append(float(loss))
+    return out
+
+
+def test_zero_pp_matches_plain_pp():
+    """ZeRO × pp=2: same losses as pp=2 without ZeRO (the partitioned
+    update must not change the math)."""
+    ref = train(make_engine(pp=2, zero=False), 4)
+    got = train(make_engine(pp=2, zero=True), 4)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("zero,mp", [(False, 1), (True, 1), (True, 2)])
+def test_pp_checkpoint_resume(tmp_path, zero, mp):
+    """pp=2 train → save → fresh-engine load → resume == unbroken run."""
+    ref_engine = make_engine(pp=2, mp=mp, zero=zero)
+    ref = train(ref_engine, 6)
+
+    e1 = make_engine(pp=2, mp=mp, zero=zero)
+    train(e1, 3)
+    e1.save_checkpoint(str(tmp_path), tag="mid")
+    # per-stage files exist
+    files = os.listdir(os.path.join(str(tmp_path), "mid"))
+    assert any("pp_stage_00" in f for f in files), files
+    assert any("pp_stage_01" in f for f in files), files
+
+    e2 = make_engine(pp=2, mp=mp, zero=zero)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="mid")
+    assert path is not None
+    resumed = train(e2, 3, seed0=3)
+    np.testing.assert_allclose(resumed, ref[3:], rtol=2e-4, atol=2e-5)
+
+
+def test_resave_same_tag_under_different_pp(tmp_path):
+    """Re-saving a tag under a different pp degree must not leave stale
+    model-state files from the old naming scheme for the loader to pick."""
+    e_pp1 = make_engine(pp=1, zero=False)
+    train(e_pp1, 1)
+    e_pp1.save_checkpoint(str(tmp_path), tag="best")
+    e_pp2 = make_engine(pp=2, zero=False)
+    train(e_pp2, 2)
+    e_pp2.save_checkpoint(str(tmp_path), tag="best")
+    files = os.listdir(os.path.join(str(tmp_path), "best"))
+    assert not any(f.startswith("mp_rank_") for f in files), files
+    e_load = make_engine(pp=2, zero=False)
+    e_load.load_checkpoint(str(tmp_path), tag="best")
+    assert e_load.global_steps == e_pp2.global_steps == 2
+
+
+def test_zero_pp_shards_reject_cross_pp_restore(tmp_path):
+    """ZeRO flat partitions are per-stage; restoring them under a different
+    pp degree must fail loudly (weights-only restore stays possible)."""
+    e1 = make_engine(pp=2, zero=True)
+    train(e1, 2)
+    e1.save_checkpoint(str(tmp_path), tag="t")
+    e2 = make_engine(pp=4, zero=True)
+    with pytest.raises(ValueError, match="pipeline_parallel_size"):
+        e2.load_checkpoint(str(tmp_path), tag="t")
