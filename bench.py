@@ -167,8 +167,97 @@ def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
     }
 
 
+def run_pipeline_sweep(steps=4, warmup=2):
+    """pp ∈ {1, 2, 4, ...} GPT-2 throughput sweep at constant global batch:
+    per-chip samples/s, measured pipeline efficiency vs pp=1, and the GPipe
+    theoretical ceiling m/(m+pp-1) (VERDICT r2 #5).  Needs ≥2 devices (run
+    under the virtual CPU mesh on a single-chip host); rows on stderr, one
+    JSON summary on stdout."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Pipelined
+    from deepspeed_tpu.parallel.topology import make_mesh
+
+    n = jax.device_count()
+    if n < 2:
+        raise RuntimeError(
+            "pipeline sweep needs >= 2 devices; set JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "PALLAS_AXON_POOL_IPS= for a virtual mesh")
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    m = int(os.environ.get("BENCH_PP_MICRO", "8"))
+    # per-chip batch a multiple of m so the pp=1 baseline's per-shard batch
+    # still splits into m micro-batches
+    bpc = int(os.environ.get("BENCH_BATCH", str(m)))
+    layers = int(os.environ.get("BENCH_PP_LAYERS", "8"))
+    hidden = int(os.environ.get("BENCH_PP_HIDDEN", "256"))
+    if bpc % m:
+        raise RuntimeError(
+            f"BENCH_BATCH ({bpc}) must be a multiple of BENCH_PP_MICRO "
+            f"({m}) so the pp=1 baseline runs (eff_vs_pp1 is relative to "
+            f"it)")
+    B = bpc * n  # constant global batch across pp configs
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50257, size=(B, seq)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+
+    rows = []
+    pp = 1
+    while pp <= n:
+        per_shard = B * pp // n  # batch per (dp) shard
+        if per_shard % m or layers % pp:
+            pp *= 2
+            continue
+        model = GPT2Pipelined.from_size(
+            "tiny", num_micro_batches=m, vocab_size=50257, max_seq_len=seq,
+            num_layers=layers, hidden_size=hidden,
+            num_heads=max(4, hidden // 64))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config={"train_batch_size": B, "steps_per_print": 10 ** 9,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": True}},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            mesh=make_mesh(pipeline_parallel_size=pp))
+        for _ in range(warmup):
+            loss = engine.train_batch((toks, labels))
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch((toks, labels))
+        float(loss)
+        dt = time.perf_counter() - t0
+        per_chip = B * steps / dt / n
+        rows.append({"pp": pp, "per_chip": round(per_chip, 2),
+                     "theory_eff": round(m / (m + pp - 1), 3)})
+        print(f"pp={pp}: {per_chip:.2f} samples/s/chip "
+              f"(theory ceiling {m}/{m + pp - 1} = {m / (m + pp - 1):.3f} "
+              f"of pp=1)", file=sys.stderr)
+        pp *= 2
+
+    base = rows[0]["per_chip"]
+    for r in rows:
+        r["eff_vs_pp1"] = round(r["per_chip"] / base, 3)
+        r["bubble_fraction"] = round(1.0 - r["per_chip"] / base, 3)
+    out = {"metric": "gpt2_pipeline_sweep", "unit": "samples/s/chip",
+           "num_micro_batches": m, "rows": rows}
+    if jax.devices()[0].platform != "tpu":
+        # virtual CPU devices share one host: per-chip numbers measure the
+        # schedule's program structure, not ICI/bubble costs
+        out["note"] = "virtual CPU mesh; per-chip figures not hardware-true"
+    print(json.dumps(out))
+    return 0
+
+
 def main():
     import jax
+
+    if os.environ.get("BENCH_PP_SWEEP", "0") == "1":
+        return run_pipeline_sweep(
+            steps=int(os.environ.get("BENCH_STEPS", "4")))
 
     on_tpu = jax.devices()[0].platform == "tpu"
     seq = int(os.environ.get("BENCH_SEQ", "128"))

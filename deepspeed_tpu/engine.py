@@ -1079,8 +1079,9 @@ class DeepSpeedTpuEngine:
                 grads = jax.tree_util.tree_map(lambda g: g / mp, grads)
             if self.pp_world_size > 1:
                 # same psum-transpose mechanism over the pipe axis: the loss
-                # is replicated across pp stages (mask_to_last_stage psum),
-                # so every leaf's grad carries a uniform pp factor — verified
+                # is pipe-uniform (a psum of per-stage partials —
+                # pipe_sharded_loss, or its mask_to_last_stage fallback), so
+                # every leaf's grad carries a uniform pp factor — verified
                 # empirically at pp=2 (a one-step SGD update was exactly
                 # 2x the pp=1 reference before this correction)
                 pp = float(self.pp_world_size)

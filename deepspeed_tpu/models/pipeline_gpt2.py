@@ -69,12 +69,20 @@ class GPT2Pipelined(GPT2):
 
         x = pipe_mod.pipeline_apply(x_micro, stage_fn)
         x = x.reshape(B, T_len, x.shape[-1])
-        x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
-        logits = L.vocab_parallel_logits(x, params["wte"])
-        loss = L.vocab_parallel_cross_entropy(logits, labels)
-        loss = L.masked_mean_loss(loss, labels >= 0)
-        # exactly one stage contributes the loss (and head/embed grads);
-        # the engine completes replicated-leaf grads with a pipe psum
-        return pipe_mod.mask_to_last_stage(jnp.asarray(loss, jnp.float32))
+
+        # head sharded over the pipe stages: each computes LN + vocab
+        # logits + CE for its 1/pp batch slice (pipe_sharded_loss) instead
+        # of every stage repeating the full O(B·T·V·H) head; the psum'd
+        # scalar stays pipe-uniform, so replicated-leaf grads still arrive
+        # as per-stage partials the engine completes over 'pipe'
+        def head_fn(xs, ys):
+            h = L.layer_norm(xs, params["lnf_s"], params["lnf_b"],
+                             cfg.ln_eps)
+            logits = L.vocab_parallel_logits(h, params["wte"])
+            ce = L.vocab_parallel_cross_entropy(logits, ys)
+            mask = (ys >= 0).astype(jnp.float32)
+            return jnp.sum(ce * mask), jnp.sum(mask)
+
+        return pipe_mod.pipe_sharded_loss(x, labels, head_fn)
 
     __call__ = apply

@@ -155,6 +155,21 @@ def test_pipelined_sgd_scale_parity():
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
 
 
+def test_sharded_head_fallback_indivisible_batch():
+    """Per-shard batch 1 under pp=2 cannot split across stages; the head
+    falls back to the replicated mask_to_last_stage path and the trajectory
+    still matches plain GPT-2."""
+    kw = dict(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=4,
+              hidden_size=32, num_heads=4)
+    plain = GPT2.from_size("tiny", **kw)
+    pipelined = GPT2Pipelined.from_size("tiny", num_micro_batches=1, **kw)
+    ref, _ = run_engine(plain, make_mesh(devices=jax.devices()[:4]),
+                        batch=4)
+    got, _ = run_engine(pipelined, make_mesh(pipeline_parallel_size=2),
+                        batch=4)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
 def test_zero_and_checkpoint_compose_with_pipeline(tmpdir):
     """ZeRO-1 and checkpointing now compose with pp>1 (trajectory/resume
     parity pinned in tests/test_pipeline_ckpt.py); this pins the API accepts
