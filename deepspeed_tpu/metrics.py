@@ -1,0 +1,141 @@
+"""SQuAD-style evaluation metrics: exact match + token F1.
+
+The reference ships a full SQuAD fine-tune-to-F1 suite
+(/root/reference/tests/model/BingBertSquad/BingBertSquad_run_func_test.py,
+run_BingBertSquad.sh drives evaluate-v1.1-style EM/F1); this module is the
+TPU-native analog used by ``examples/bert/squad_finetune.py`` and
+``tests/model/test_squad_f1.py``:
+
+* text metrics — the official SQuAD v1.1 normalization (lowercase, strip
+  punctuation/articles/extra whitespace) with whitespace-token F1, for real
+  SQuAD predictions;
+* span metrics — position-level EM / overlap-F1 over (start, end) token
+  spans, the tokenizer-free equivalent used with synthetic corpora;
+* ``best_spans`` — the standard argmax over valid (start <= end,
+  length <= max_answer_len) pairs, vectorized over the batch (jit-safe).
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# ------------------------------------------------------------- text metrics
+
+
+def normalize_answer(s: str) -> str:
+    """Official SQuAD v1.1 normalization: lower, strip punctuation,
+    articles, and extra whitespace."""
+    s = s.lower()
+    s = "".join(ch for ch in s if ch not in set(string.punctuation))
+    s = re.sub(r"\b(a|an|the)\b", " ", s)
+    return " ".join(s.split())
+
+
+def text_exact_match(prediction: str, ground_truth: str) -> float:
+    return float(normalize_answer(prediction) == normalize_answer(ground_truth))
+
+
+def text_f1(prediction: str, ground_truth: str) -> float:
+    pred_toks = normalize_answer(prediction).split()
+    gold_toks = normalize_answer(ground_truth).split()
+    if not pred_toks or not gold_toks:
+        return float(pred_toks == gold_toks)
+    common = collections.Counter(pred_toks) & collections.Counter(gold_toks)
+    overlap = sum(common.values())
+    if overlap == 0:
+        return 0.0
+    precision = overlap / len(pred_toks)
+    recall = overlap / len(gold_toks)
+    return 2 * precision * recall / (precision + recall)
+
+
+def metric_max_over_ground_truths(metric_fn, prediction: str,
+                                  ground_truths: Sequence[str]) -> float:
+    """SQuAD rule: score against every annotated answer, keep the best."""
+    return max(metric_fn(prediction, gt) for gt in ground_truths)
+
+
+# ------------------------------------------------------------- span metrics
+
+
+def best_spans(start_logits, end_logits, attention_mask=None,
+               max_answer_len: int = 30) -> Tuple[np.ndarray, np.ndarray]:
+    """Batch argmax over valid (start, end) pairs.
+
+    start_logits/end_logits: [B, T]; attention_mask: optional [B, T] (0 =
+    padding, excluded).  Valid pairs satisfy start <= end and
+    end - start < max_answer_len.  Returns (starts, ends) int arrays [B].
+    """
+    sl = jnp.asarray(start_logits, jnp.float32)
+    el = jnp.asarray(end_logits, jnp.float32)
+    if attention_mask is not None:
+        valid = jnp.asarray(attention_mask) > 0
+        sl = jnp.where(valid, sl, -1e9)
+        el = jnp.where(valid, el, -1e9)
+    T = sl.shape[-1]
+    scores = sl[:, :, None] + el[:, None, :]          # [B, S, E]
+    s_idx = jnp.arange(T)[:, None]
+    e_idx = jnp.arange(T)[None, :]
+    band = (e_idx >= s_idx) & (e_idx - s_idx < max_answer_len)
+    scores = jnp.where(band[None], scores, -jnp.inf)
+    flat = jnp.argmax(scores.reshape(scores.shape[0], -1), axis=-1)
+    return np.asarray(flat // T), np.asarray(flat % T)
+
+
+def make_span_predictor(model, params):
+    """Single-device replicated predictor for EM/F1 evaluation.
+
+    The vocab-parallel embedding inside the encoder needs a bound model
+    axis, so the prediction runs under ``shard_map`` over a one-device
+    mesh with everything replicated.  ``params`` may be engine-sharded;
+    a host copy is taken.  Returns ``predict(ids, attn, tt) ->
+    (start_logits, end_logits)``.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.parallel.topology import make_mesh
+
+    host = jax.tree_util.tree_map(np.asarray, params)
+    rep = jax.tree_util.tree_map(lambda _: P(), host)
+    mesh = make_mesh(model_parallel_size=1, devices=jax.devices()[:1])
+    fn = jax.jit(jax.shard_map(
+        lambda p, i, a, t: model.span_logits(p, i, a, t), mesh=mesh,
+        in_specs=(rep, P(), P(), P()), out_specs=(P(), P()),
+        check_vma=False))
+    return lambda i, a, t: fn(host, i, a, t)
+
+
+def span_exact_match(pred_span: Tuple[int, int],
+                     gold_span: Tuple[int, int]) -> float:
+    return float(tuple(pred_span) == tuple(gold_span))
+
+
+def span_f1(pred_span: Tuple[int, int], gold_span: Tuple[int, int]) -> float:
+    """Token-overlap F1 between two inclusive [start, end] position spans."""
+    ps, pe = int(pred_span[0]), int(pred_span[1])
+    gs, ge = int(gold_span[0]), int(gold_span[1])
+    overlap = max(0, min(pe, ge) - max(ps, gs) + 1)
+    if overlap == 0:
+        return 0.0
+    precision = overlap / (pe - ps + 1)
+    recall = overlap / (ge - gs + 1)
+    return 2 * precision * recall / (precision + recall)
+
+
+def evaluate_spans(pred_starts, pred_ends, gold_starts, gold_ends) -> dict:
+    """Aggregate position-span EM/F1 as percentages (SQuAD convention)."""
+    em, f1, n = 0.0, 0.0, 0
+    for ps, pe, gs, ge in zip(np.asarray(pred_starts), np.asarray(pred_ends),
+                              np.asarray(gold_starts), np.asarray(gold_ends)):
+        em += span_exact_match((ps, pe), (gs, ge))
+        f1 += span_f1((ps, pe), (gs, ge))
+        n += 1
+    return {"exact_match": 100.0 * em / max(n, 1),
+            "f1": 100.0 * f1 / max(n, 1), "total": n}

@@ -235,18 +235,24 @@ class BertForQuestionAnswering:
         specs.update({"qa_w": P(), "qa_b": P()})
         return specs
 
-    def apply(self, params, input_ids, attention_mask, token_type_ids,
-              start_positions, end_positions):
-        cfg = self.config
+    def span_logits(self, params, input_ids, attention_mask, token_type_ids):
+        """(start_logits, end_logits), each [B, T] fp32 — the prediction
+        path for EM/F1 evaluation (metrics.best_spans)."""
         if L.axis_size_or_1(SEQ_AXIS) > 1:
             raise NotImplementedError(
                 "span extraction softmaxes over the FULL sequence and "
                 "indexes global positions — not supported under "
                 "context_parallel_size > 1 (fine-tune lengths don't need it)")
+        cfg = self.config
         x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
         logits = (x @ params["qa_w"].astype(x.dtype)
                   + params["qa_b"].astype(x.dtype)).astype(jnp.float32)
-        start_logits, end_logits = logits[..., 0], logits[..., 1]
+        return logits[..., 0], logits[..., 1]
+
+    def apply(self, params, input_ids, attention_mask, token_type_ids,
+              start_positions, end_positions):
+        start_logits, end_logits = self.span_logits(
+            params, input_ids, attention_mask, token_type_ids)
 
         def span_loss(lg, pos):
             lg = jnp.where(attention_mask.astype(jnp.bool_), lg, -1e9)

@@ -1,0 +1,215 @@
+"""SQuAD fine-tune-to-F1 driver for BertForQuestionAnswering.
+
+The BingBertSquad analog (/root/reference/tests/model/BingBertSquad/
+run_BingBertSquad.sh + BingBertSquad_run_func_test.py:14-30): fine-tune the
+span head through the engine, report ``bert_squad_progress: step=N
+loss=...`` lines (the shape the reference's test greps), and evaluate
+EM/F1 at the end.
+
+* With ``--train-file/--predict-file`` pointing at SQuAD v1.1 JSON, a
+  whitespace tokenizer + on-the-fly vocab featurize (question, context)
+  pairs (no external tokenizer downloads); predictions map back to context
+  words and score with the official normalization (metrics.text_f1).
+* Without files, a synthetic answerable-span corpus runs anywhere:
+
+    python examples/bert/squad_finetune.py \
+        --deepspeed_config examples/bert/ds_config_lamb.json --steps 150
+"""
+
+import os as _os
+import sys as _sys
+
+# run from a checkout without installing (docs/install.md covers
+# pip install; this keeps `python examples/...` working in-place)
+_REPO_ROOT = _os.path.abspath(
+    _os.path.join(_os.path.dirname(__file__), "..", ".."))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+import argparse
+import json
+
+import jax
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu import metrics
+from deepspeed_tpu.models import BertForQuestionAnswering
+
+PAD, CLS, SEP, UNK = 0, 1, 2, 3
+
+
+# ----------------------------------------------------------- real SQuAD path
+
+def load_squad(path, seq_len, vocab, limit=None):
+    """(features, answers, n_dropped): whitespace-tokenized
+    [CLS] q [SEP] ctx windows with start/end word positions mapped into the
+    window; ``n_dropped`` counts answers falling outside the context
+    window (no striding)."""
+    with open(path) as f:
+        data = json.load(f)["data"]
+    feats, answers = [], []
+    dropped = 0
+    for article in data:
+        for para in article["paragraphs"]:
+            ctx_words = para["context"].split()
+            for qa in para["qas"]:
+                if not qa.get("answers"):
+                    continue
+                ans = qa["answers"][0]
+                # char offset -> word index; an answer starting mid-word
+                # ('$400' with answer_start at the '4') belongs to the
+                # PRECEDING split word, not the next one
+                upto = para["context"][:ans["answer_start"]]
+                ws = len(upto.split())
+                if upto and not upto[-1].isspace():
+                    ws = max(0, ws - 1)
+                alen = max(1, len(ans["text"].split()))
+                q_words = qa["question"].split()[:seq_len // 4]
+                ctx_budget = seq_len - len(q_words) - 3
+                if ws + alen > ctx_budget:
+                    dropped += 1
+                    continue  # answer outside the window (no striding)
+                ids = [CLS] + [vocab(w) for w in q_words] + [SEP]
+                off = len(ids)
+                ids += [vocab(w) for w in ctx_words[:ctx_budget]] + [SEP]
+                ids = ids[:seq_len] + [PAD] * (seq_len - len(ids))
+                tt = [0] * off + [1] * (seq_len - off)
+                attn = [1 if t != PAD else 0 for t in ids]
+                feats.append((np.array(ids, np.int32),
+                              np.array(attn, np.int32),
+                              np.array(tt, np.int32),
+                              np.int32(off + ws),
+                              np.int32(off + ws + alen - 1)))
+                answers.append((ctx_words, off,
+                                [a["text"] for a in qa["answers"]]))
+                if limit and len(feats) >= limit:
+                    return feats, answers, dropped
+    return feats, answers, dropped
+
+
+class Vocab:
+    def __init__(self, size):
+        self.size = size
+        self.table = {}
+
+    def __call__(self, word):
+        w = word.lower()
+        if w not in self.table:
+            if len(self.table) + 4 >= self.size:
+                return UNK
+            self.table[w] = 4 + len(self.table)
+        return self.table[w]
+
+
+# ----------------------------------------------------------- synthetic path
+
+def synthetic_batch(rng, batch, seq_len, vocab_size):
+    """Answerable spans marked in-band: token 1 opens, token 2 closes."""
+    ids = rng.integers(4, vocab_size, size=(batch, seq_len)).astype(np.int32)
+    start = rng.integers(1, seq_len - 4, size=(batch,)).astype(np.int32)
+    end = (start + 2).astype(np.int32)
+    for b in range(batch):
+        ids[b, start[b]] = 1
+        ids[b, end[b]] = 2
+    return (ids, np.ones_like(ids), np.zeros_like(ids), start, end)
+
+
+# ------------------------------------------------------------------- driver
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=150)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--vocab-size", type=int, default=8192)
+    parser.add_argument("--max-answer-len", type=int, default=30)
+    parser.add_argument("--train-file", help="SQuAD v1.1 train json")
+    parser.add_argument("--predict-file", help="SQuAD v1.1 dev json")
+    deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args()
+
+    real = bool(args.train_file)
+    vocab_size = args.vocab_size if real else 128
+    model = BertForQuestionAnswering.from_size(
+        "tiny", vocab_size=vocab_size, max_seq_len=args.seq_len,
+        num_layers=4, hidden_size=128, num_heads=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    batch_size = (engine.train_micro_batch_size_per_gpu()
+                  * engine.dp_world_size
+                  * engine.gradient_accumulation_steps())
+
+    if real:
+        vocab = Vocab(vocab_size)
+        feats, _, dropped = load_squad(args.train_file, args.seq_len, vocab)
+        if not feats:
+            raise RuntimeError(
+                f"no {args.train_file} examples fit the --seq-len "
+                f"{args.seq_len} context window ({dropped} dropped); "
+                f"raise --seq-len")
+        if dropped:
+            print(f"load_squad: {dropped} answers fell outside the "
+                  f"--seq-len {args.seq_len} window and were dropped "
+                  f"({len(feats)} kept)")
+        order = np.random.default_rng(0).permutation(len(feats))
+        def batches():
+            i = 0
+            while True:
+                take = [feats[order[(i + k) % len(feats)]]
+                        for k in range(batch_size)]
+                i += batch_size
+                yield tuple(np.stack([f[j] for f in take])
+                            for j in range(5))
+        gen = batches()
+        next_batch = lambda: next(gen)
+    else:
+        rng = np.random.default_rng(0)
+        next_batch = lambda: synthetic_batch(rng, batch_size, args.seq_len,
+                                             vocab_size)
+
+    for step in range(args.steps):
+        loss = float(engine.train_batch(next_batch()))
+        if step % 10 == 0 or step == args.steps - 1:
+            # the reference's grep-able progress line shape
+            print(f"bert_squad_progress: step={step} lr="
+                  f"{engine.optimizer.param_groups[0]['lr']} loss={loss}")
+
+    predict = metrics.make_span_predictor(model, engine.params)
+    if real and args.predict_file:
+        vocab_eval = vocab
+        feats, answers, _ = load_squad(args.predict_file, args.seq_len,
+                                       vocab_eval, limit=2048)
+        em = f1 = 0.0
+        for (ids, attn, tt, _, _), (ctx_words, off, golds) in zip(feats,
+                                                                  answers):
+            sl, el = predict(ids[None], attn[None], tt[None])
+            ps, pe = metrics.best_spans(sl, el, attn[None],
+                                        args.max_answer_len)
+            s, e = int(ps[0]) - off, int(pe[0]) - off
+            pred = " ".join(ctx_words[max(s, 0):max(e + 1, 0)])
+            em += metrics.metric_max_over_ground_truths(
+                metrics.text_exact_match, pred, golds)
+            f1 += metrics.metric_max_over_ground_truths(
+                metrics.text_f1, pred, golds)
+        n = len(feats)
+        print(json.dumps({"exact_match": 100.0 * em / n,
+                          "f1": 100.0 * f1 / n, "total": n}))
+    else:
+        eval_rng = np.random.default_rng(999)
+        agg_em = agg_f1 = total = 0.0
+        for _ in range(4):
+            ids, attn, tt, gs, ge = synthetic_batch(
+                eval_rng, 32, args.seq_len, vocab_size)
+            sl, el = predict(ids, attn, tt)
+            ps, pe = metrics.best_spans(sl, el, attn, max_answer_len=8)
+            r = metrics.evaluate_spans(ps, pe, gs, ge)
+            agg_em += r["exact_match"] * r["total"]
+            agg_f1 += r["f1"] * r["total"]
+            total += r["total"]
+        print(json.dumps({"exact_match": agg_em / total,
+                          "f1": agg_f1 / total, "total": int(total)}))
+
+
+if __name__ == "__main__":
+    main()

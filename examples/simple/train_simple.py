@@ -8,6 +8,16 @@ observables, and checkpoint save/resume.
         --deepspeed_config examples/simple/ds_config.json
 """
 
+import os as _os
+import sys as _sys
+
+# run from a checkout without installing (docs/install.md covers
+# pip install; this keeps `python examples/...` working in-place)
+_REPO_ROOT = _os.path.abspath(
+    _os.path.join(_os.path.dirname(__file__), "..", ".."))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 import argparse
 import os
 

@@ -1,0 +1,163 @@
+"""SQuAD-style fine-tune-to-F1 harness (BingBertSquad analog).
+
+BASELINE.md's north star is wall-clock to *F1 parity*; the reference ships
+a fine-tune suite asserting EM/F1 after a SQuAD run
+(/root/reference/tests/model/BingBertSquad/BingBertSquad_run_func_test.py:14-30,
+run_BingBertSquad.sh).  Synthetic answerable-span corpus here (real SQuAD
+files wire through examples/bert/squad_finetune.py): the engine fine-tune
+must reach high F1 and land within 1 point of a plain-JAX fp32 baseline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu import metrics
+from deepspeed_tpu.models import BertForQuestionAnswering
+from deepspeed_tpu.ops import optim as optim_mod
+from deepspeed_tpu.parallel.topology import make_mesh
+
+VOCAB, SEQ, BATCH, STEPS = 128, 32, 16, 150
+
+
+def model_fn():
+    return BertForQuestionAnswering.from_size(
+        "tiny", vocab_size=VOCAB, max_seq_len=SEQ, num_layers=2,
+        hidden_size=64, num_heads=4)
+
+
+def qa_batch(rng, batch=BATCH):
+    """Answerable spans marked in-band: token 1 opens, token 2 closes."""
+    ids = rng.integers(4, VOCAB, size=(batch, SEQ)).astype(np.int32)
+    start = rng.integers(1, SEQ - 4, size=(batch,)).astype(np.int32)
+    end = (start + 2).astype(np.int32)
+    for b in range(batch):
+        ids[b, start[b]] = 1
+        ids[b, end[b]] = 2
+    attn = np.ones_like(ids)
+    tt = np.zeros_like(ids)
+    return ids, attn, tt, start, end
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(0)
+    train = [qa_batch(rng) for _ in range(STEPS)]
+    eval_rng = np.random.default_rng(10_000)
+    dev = [qa_batch(eval_rng, batch=32) for _ in range(4)]
+    return train, dev
+
+
+def evaluate_f1(model, params, dev):
+    """EM/F1 over the dev set via the span-prediction path."""
+    predict = metrics.make_span_predictor(model, params)
+    agg = {"exact_match": 0.0, "f1": 0.0, "total": 0}
+    for ids, attn, tt, start, end in dev:
+        sl, el = predict(ids, attn, tt)
+        ps, pe = metrics.best_spans(sl, el, attn, max_answer_len=8)
+        r = metrics.evaluate_spans(ps, pe, start, end)
+        w = r["total"]
+        agg["exact_match"] += r["exact_match"] * w
+        agg["f1"] += r["f1"] * w
+        agg["total"] += w
+    agg["exact_match"] /= agg["total"]
+    agg["f1"] /= agg["total"]
+    return agg
+
+
+@pytest.fixture(scope="module")
+def baseline_f1(corpus):
+    """Plain-JAX fp32 Adam fine-tune of the same model/data."""
+    train, dev = corpus
+    model = model_fn()
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32),
+        model.init_params(jax.random.PRNGKey(1)))
+    opt = optim_mod.Adam(lr=2e-3)
+    state = opt.init(params)
+    mesh = make_mesh(model_parallel_size=1, devices=jax.devices()[:1])
+
+    def local(params, state, *batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.apply(p, *batch))(params)
+        new_p, new_s = opt.update(params, grads, state, lr=2e-3)
+        return new_p, new_s, loss
+
+    rep = lambda t: jax.tree_util.tree_map(lambda _: P(), t)
+    step = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(rep(params), rep(state)) + (P(),) * 5,
+        out_specs=(rep(params), rep(state), P()), check_vma=False))
+    for batch in train:
+        params, state, _ = step(params, state, *batch)
+    return evaluate_f1(model, params, dev)
+
+
+def test_engine_finetune_reaches_baseline_f1(corpus, baseline_f1):
+    """Engine fine-tune (bf16) F1 within 1 point of the fp32 baseline —
+    the reference suite's pass criterion shape."""
+    train, dev = corpus
+    model = model_fn()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": BATCH,
+                "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 2e-3}},
+                "bf16": {"enabled": True}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(1)),
+        mesh=make_mesh(model_parallel_size=1))
+    for batch in train:
+        engine.train_batch(batch)
+    got = evaluate_f1(model, engine.params, dev)
+    assert baseline_f1["f1"] > 90.0, baseline_f1
+    assert got["f1"] > baseline_f1["f1"] - 1.0, (got, baseline_f1)
+    assert got["exact_match"] > baseline_f1["exact_match"] - 2.0, (
+        got, baseline_f1)
+
+
+def test_load_squad_midword_answer_offset(tmp_path):
+    """Answers starting mid-word ('$400' with answer_start at the '4')
+    must map to the containing split word, not the following one."""
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "squad_finetune", os.path.join(
+            os.path.dirname(__file__), "..", "..", "examples", "bert",
+            "squad_finetune.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ctx = "It cost $400 million total"
+    data = {"data": [{"paragraphs": [{"context": ctx, "qas": [
+        {"id": "q0", "question": "how much",
+         "answers": [{"text": "400", "answer_start": ctx.index("400")}]},
+    ]}]}]}
+    p = tmp_path / "mini.json"
+    p.write_text(json.dumps(data))
+    feats, answers, dropped = mod.load_squad(str(p), 32, mod.Vocab(64))
+    assert dropped == 0 and len(feats) == 1
+    ids, attn, tt, start, end = feats[0]
+    ctx_words, off, _ = answers[0]
+    # '$400' is context word 2; both span ends point at it
+    assert start - off == 2 and end - off == 2
+
+
+def test_metric_unit_semantics():
+    """Metric math pinned: official text normalization + span overlap."""
+    assert metrics.text_exact_match("The Cat!", "cat") == 1.0
+    assert metrics.text_f1("the cat sat", "a cat") == pytest.approx(2 / 3)
+    assert metrics.span_f1((3, 5), (3, 5)) == 1.0
+    assert metrics.span_f1((3, 5), (5, 7)) == pytest.approx(1 / 3)
+    assert metrics.span_f1((0, 1), (4, 5)) == 0.0
+    sl = np.full((1, 8), -5.0)
+    el = np.full((1, 8), -5.0)
+    sl[0, 2] = 5.0
+    el[0, 4] = 5.0
+    ps, pe = metrics.best_spans(sl, el, max_answer_len=8)
+    assert (ps[0], pe[0]) == (2, 4)
+    # max_answer_len forbids the wide span; falls back to best short one
+    ps, pe = metrics.best_spans(sl, el, max_answer_len=2)
+    assert pe[0] - ps[0] < 2
