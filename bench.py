@@ -252,12 +252,65 @@ def run_pipeline_sweep(steps=4, warmup=2):
     return 0
 
 
+def run_attention_sweep(steps=10, warmup=3):
+    """GPT-2 long-sequence throughput with the streaming Pallas attention
+    kernel vs the XLA einsum path (VERDICT r2 #7).  The dispatch env is
+    read at trace time, so each mode builds its own engine.  Rows on
+    stderr, one JSON summary on stdout."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2
+
+    T = int(os.environ.get("BENCH_SEQ", "1024"))
+    B = int(os.environ.get("BENCH_BATCH", "8"))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50304, size=(B, T)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+
+    rows = []
+    for mode in ("0", "auto"):
+        os.environ["DSTPU_FUSED_ATTN"] = mode
+        model = GPT2.from_size("tiny", vocab_size=50304, max_seq_len=T,
+                               num_layers=12, hidden_size=768, num_heads=12)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config={"train_batch_size": B, "steps_per_print": 10 ** 9,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": True},
+                    "activation_checkpointing": {"enabled": True,
+                                                 "policy": "selective"}},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(0)))
+        for _ in range(warmup):
+            loss = engine.train_batch((toks, labels))
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch((toks, labels))
+        float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        rows.append({"attn": "xla" if mode == "0" else "stream-pallas",
+                     "ms_per_step": round(dt * 1000, 1),
+                     "samples_per_sec": round(B / dt, 2)})
+        print(f"attn={rows[-1]['attn']}: {rows[-1]['ms_per_step']} ms/step",
+              file=sys.stderr)
+    speedup = rows[0]["ms_per_step"] / rows[1]["ms_per_step"]
+    print(json.dumps({"metric": f"gpt2_seq{T}_attention_kernel_speedup",
+                      "value": round(speedup, 3), "unit": "x vs XLA path",
+                      "rows": rows}))
+    return 0
+
+
 def main():
     import jax
 
     if os.environ.get("BENCH_PP_SWEEP", "0") == "1":
         return run_pipeline_sweep(
             steps=int(os.environ.get("BENCH_STEPS", "4")))
+    if os.environ.get("BENCH_ATTN_SWEEP", "0") == "1":
+        return run_attention_sweep(
+            steps=int(os.environ.get("BENCH_STEPS", "10")))
 
     on_tpu = jax.devices()[0].platform == "tpu"
     seq = int(os.environ.get("BENCH_SEQ", "128"))

@@ -33,13 +33,20 @@ from jax.ad_checkpoint import checkpoint_name
 
 from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 
-# fused Pallas attention (scores never leave VMEM), DSTPU_FUSED_ATTN=1 to
-# enable.  Off by default: measured on a v5e chip at BERT-large/seq128 the
-# XLA einsum path is ~8% faster end-to-end (XLA's own attention fusion is
-# strong at these shapes, and the kernel's heads-first transposes cost HBM
-# copies); the kernel is kept as the building block for shapes/backends
-# where score materialisation dominates — measure on your workload.
-_FUSED_ATTN = os.environ.get("DSTPU_FUSED_ATTN", "0") == "1"
+# Pallas attention dispatch (DSTPU_FUSED_ATTN = "auto" | "1" | "0").
+# Measured on a v5e chip (fwd+bwd vs the XLA einsum path, causal bf16):
+#   seq 128 (BERT-large):  whole-tile kernel ~8% SLOWER  -> XLA
+#   seq 512:               streaming kernel  ~parity     -> XLA
+#   seq 1024:              streaming kernel  1.67x FASTER
+#   seq 2048:              streaming kernel  1.49x FASTER
+# "auto" (default) uses the online-softmax streaming kernel from
+# STREAM_AUTO_MIN tokens up, XLA below; "1" forces a kernel wherever one
+# supports the shape; "0" disables both.
+STREAM_AUTO_MIN = 1024
+
+
+def _attn_mode() -> str:
+    return os.environ.get("DSTPU_FUSED_ATTN", "auto")
 
 
 def axis_size_or_1(axis) -> int:
@@ -196,12 +203,19 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
         ctx = ctx.reshape(B, T, n_local * d)
         return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
 
-    if (_FUSED_ATTN and jax.default_backend() == "tpu"):
+    mode = _attn_mode()
+    if mode != "0" and jax.default_backend() == "tpu":
         from deepspeed_tpu.ops import pallas_attention as pattn
-        if pattn.supported(T, n_local, d):
+        use_stream = pattn.stream_supported(T, d) and (
+            mode == "1" or T >= STREAM_AUTO_MIN)
+        use_block = (not use_stream and mode == "1"
+                     and pattn.supported(T, n_local, d))
+        if use_stream or use_block:
             mvec = (jnp.ones((B, T), jnp.float32) if attn_mask is None
                     else attn_mask.astype(jnp.float32))
-            ctx = pattn.fused_attention(q, k, v, mvec, causal)
+            impl = (pattn.stream_attention if use_stream
+                    else pattn.fused_attention)
+            ctx = impl(q, k, v, mvec, causal)
             ctx = ctx.reshape(B, T, n_local * d)
             return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
 

@@ -128,6 +128,11 @@ def main():
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
+    if args.predict_file and not args.train_file:
+        raise SystemExit(
+            "--predict-file requires --train-file (the vocab is built "
+            "during training; evaluating an untrained model on real SQuAD "
+            "is not meaningful)")
     real = bool(args.train_file)
     vocab_size = args.vocab_size if real else 128
     model = BertForQuestionAnswering.from_size(
@@ -177,21 +182,33 @@ def main():
 
     predict = metrics.make_span_predictor(model, engine.params)
     if real and args.predict_file:
-        vocab_eval = vocab
-        feats, answers, _ = load_squad(args.predict_file, args.seq_len,
-                                       vocab_eval, limit=2048)
+        feats, answers, dev_dropped = load_squad(
+            args.predict_file, args.seq_len, vocab, limit=2048)
+        if not feats:
+            raise RuntimeError(
+                f"no {args.predict_file} examples fit the --seq-len "
+                f"{args.seq_len} context window ({dev_dropped} dropped); "
+                f"raise --seq-len")
+        # batched prediction: one dispatch per 32 examples, padded by
+        # repeating the last feature (padding rows are sliced off)
         em = f1 = 0.0
-        for (ids, attn, tt, _, _), (ctx_words, off, golds) in zip(feats,
-                                                                  answers):
-            sl, el = predict(ids[None], attn[None], tt[None])
-            ps, pe = metrics.best_spans(sl, el, attn[None],
-                                        args.max_answer_len)
-            s, e = int(ps[0]) - off, int(pe[0]) - off
-            pred = " ".join(ctx_words[max(s, 0):max(e + 1, 0)])
-            em += metrics.metric_max_over_ground_truths(
-                metrics.text_exact_match, pred, golds)
-            f1 += metrics.metric_max_over_ground_truths(
-                metrics.text_f1, pred, golds)
+        eb = 32
+        for lo in range(0, len(feats), eb):
+            chunk = feats[lo:lo + eb]
+            pad = eb - len(chunk)
+            batch = chunk + [chunk[-1]] * pad
+            ids, attn, tt = (np.stack([f[j] for f in batch])
+                             for j in range(3))
+            sl, el = predict(ids, attn, tt)
+            ps, pe = metrics.best_spans(sl, el, attn, args.max_answer_len)
+            for k, (ctx_words, off, golds) in enumerate(
+                    answers[lo:lo + eb]):
+                s, e = int(ps[k]) - off, int(pe[k]) - off
+                pred = " ".join(ctx_words[max(s, 0):max(e + 1, 0)])
+                em += metrics.metric_max_over_ground_truths(
+                    metrics.text_exact_match, pred, golds)
+                f1 += metrics.metric_max_over_ground_truths(
+                    metrics.text_f1, pred, golds)
         n = len(feats)
         print(json.dumps({"exact_match": 100.0 * em / n,
                           "f1": 100.0 * f1 / n, "total": n}))

@@ -98,3 +98,71 @@ def test_supported_gate():
     assert pattn.supported(128, 12, 64)
     assert pattn._head_block(12) == 12
     assert pattn._head_block(16) == 8
+
+
+# ------------------------------------------------------- streaming kernel
+
+ST, SN, SD = 512, 2, 16  # seq must be a STREAM tile multiple
+
+
+def stream_reference(q, k, v, mask, causal):
+    scores = jnp.einsum("btnd,bsnd->bnts", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(SD, jnp.float32))
+    Tn = q.shape[1]
+    if causal:
+        cmask = jnp.tril(jnp.ones((Tn, Tn), jnp.bool_))
+        scores = jnp.where(cmask[None, None], scores, -1e9)
+    scores = jnp.where(mask[:, None, None, :].astype(jnp.bool_),
+                       scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnts,bsnd->btnd", probs, v)
+
+
+def stream_qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, ST, SN, SD)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal,masked", [
+    (False, False), (True, False), (False, True), (True, True)])
+def test_stream_forward_parity(causal, masked):
+    q, k, v = stream_qkv()
+    mask = np.ones((2, ST), np.float32)
+    if masked:
+        mask[:, ST - 37:] = 0.0
+    mask = jnp.asarray(mask)
+    got = pattn.stream_attention(q, k, v, mask, causal, True)
+    want = stream_reference(q, k, v, mask, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_stream_gradient_parity(causal):
+    q, k, v = stream_qkv(seed=3)
+    mask = np.ones((2, ST), np.float32)
+    mask[:, ST - 19:] = 0.0
+    mask = jnp.asarray(mask)
+
+    def loss_s(q, k, v):
+        return jnp.sum(jnp.sin(
+            pattn.stream_attention(q, k, v, mask, causal, True)))
+
+    def loss_r(q, k, v):
+        return jnp.sum(jnp.sin(stream_reference(q, k, v, mask, causal)))
+
+    gs = jax.grad(loss_s, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_stream_supported_gate():
+    assert pattn.stream_supported(512, 64)
+    assert pattn.stream_supported(4096, 64)
+    assert not pattn.stream_supported(128, 64)   # below a tile
+    assert not pattn.stream_supported(384, 64)   # not a tile multiple
+    assert not pattn.stream_supported(512, 12)   # head dim not 8-aligned
