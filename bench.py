@@ -262,6 +262,11 @@ def run_attention_sweep(steps=10, warmup=3):
     import deepspeed_tpu
     from deepspeed_tpu.models import GPT2
 
+    if jax.default_backend() != "tpu":
+        raise RuntimeError(
+            "BENCH_ATTN_SWEEP needs a TPU backend: the kernel dispatch in "
+            "models/layers.py is TPU-gated, so off-TPU both rows would run "
+            "the XLA path and the reported speedup would be meaningless")
     T = int(os.environ.get("BENCH_SEQ", "1024"))
     B = int(os.environ.get("BENCH_BATCH", "8"))
     rng = np.random.default_rng(0)

@@ -10,12 +10,14 @@ from deepspeed_tpu.models.transformer import (TransformerConfig,
                                               block_apply, stack_apply)
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
 from deepspeed_tpu.models.pipeline_gpt2 import GPT2Pipelined
+from deepspeed_tpu.models.gpt2_moe import GPT2MoE
+from deepspeed_tpu.models.moe import MoEConfig
 from deepspeed_tpu.models.bert import (BertForPreTraining,
                                        BertForQuestionAnswering, BERT_SIZES)
 
 __all__ = [
     "TransformerConfig", "init_block_params", "block_partition_specs",
     "block_apply", "stack_apply", "GPT2", "GPT2_SIZES",
-    "GPT2Pipelined",
+    "GPT2Pipelined", "GPT2MoE", "MoEConfig",
     "BertForPreTraining", "BertForQuestionAnswering", "BERT_SIZES",
 ]

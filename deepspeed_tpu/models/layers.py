@@ -46,7 +46,15 @@ STREAM_AUTO_MIN = 1024
 
 
 def _attn_mode() -> str:
-    return os.environ.get("DSTPU_FUSED_ATTN", "auto")
+    mode = os.environ.get("DSTPU_FUSED_ATTN", "auto")
+    if mode not in ("auto", "1", "0"):
+        # fail loudly, not open: "off"/"false"/"" must not silently enable
+        # the kernel the operator meant to disable
+        raise ValueError(
+            f"DSTPU_FUSED_ATTN={mode!r} is not a valid mode: use 'auto' "
+            f"(streaming kernel from {STREAM_AUTO_MIN} tokens), '1' "
+            f"(force a kernel), or '0' (XLA only)")
+    return mode
 
 
 def axis_size_or_1(axis) -> int:

@@ -103,9 +103,13 @@ def _mlp(x, p):
     return L.row_parallel_linear(y, p["fc2_w"], p["fc2_b"])
 
 
-def block_apply(x, p, cfg: TransformerConfig, attn_mask=None):
-    """One transformer block on local shards.  p leaves have NO leading layer
-    axis here (scan slices it off)."""
+def block_with_ffn(x, p, cfg: TransformerConfig, attn_mask=None, ffn=None):
+    """One transformer block on local shards with a pluggable FFN.
+
+    ``ffn(u, p) -> (delta, aux)`` replaces the dense MLP (MoE plugs in
+    here, models/moe.py); default is the dense MLP with aux 0.  p leaves
+    have NO leading layer axis (scan slices it off).  Returns (x, aux)."""
+    f = ffn if ffn is not None else (lambda u, pp: (_mlp(u, pp), 0.0))
     attn = lambda u: L.multihead_attention(
         u, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"],
         n_heads_global=cfg.num_heads, causal=cfg.causal,
@@ -114,33 +118,46 @@ def block_apply(x, p, cfg: TransformerConfig, attn_mask=None):
     ln2 = lambda u: L.layer_norm(u, p["ln2_s"], p["ln2_b"], cfg.ln_eps)
     if cfg.pre_ln:
         x = x + attn(ln1(x))
-        x = x + _mlp(ln2(x), p)
+        delta, aux = f(ln2(x), p)
+        x = x + delta
     else:  # post-LN (BERT)
         x = ln1(x + attn(x))
-        x = ln2(x + _mlp(x, p))
+        delta, aux = f(x, p)
+        x = ln2(x + delta)
+    return x, aux
+
+
+def block_apply(x, p, cfg: TransformerConfig, attn_mask=None):
+    """One dense transformer block on local shards."""
+    x, _ = block_with_ffn(x, p, cfg, attn_mask)
     return x
+
+
+def remat_wrap(body, cfg: TransformerConfig):
+    """Apply the configured per-block rematerialisation policy to a scan
+    body (shared by the dense and MoE stacks)."""
+    if not cfg.remat:
+        return body
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_saveable)
+    if cfg.remat_policy == "selective":
+        # save qkv + pre-GELU ffn (named in layers/_mlp/moe_ffn): backward
+        # replays no matmuls, only the attention einsums and elementwise ops
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "qkv", "ffn1"))
+    if cfg.remat_policy == "full":
+        return jax.checkpoint(body)
+    raise ValueError(
+        f"unknown remat_policy {cfg.remat_policy!r} "
+        "(expected 'full', 'dots' or 'selective')")
 
 
 def stack_apply(x, stacked_params, cfg: TransformerConfig, attn_mask=None):
     """Run all layers via lax.scan over the stacked [L, ...] params."""
     def body(carry, lp):
         return block_apply(carry, lp, cfg, attn_mask), None
-    if cfg.remat:
-        if cfg.remat_policy == "dots":
-            body = jax.checkpoint(
-                body, policy=jax.checkpoint_policies.dots_saveable)
-        elif cfg.remat_policy == "selective":
-            # save qkv + pre-GELU ffn (named above): backward replays no
-            # matmuls, only the attention einsums and elementwise ops
-            body = jax.checkpoint(
-                body,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "qkv", "ffn1"))
-        elif cfg.remat_policy == "full":
-            body = jax.checkpoint(body)
-        else:
-            raise ValueError(
-                f"unknown remat_policy {cfg.remat_policy!r} "
-                "(expected 'full', 'dots' or 'selective')")
-    x, _ = jax.lax.scan(body, x, stacked_params)
+    x, _ = jax.lax.scan(remat_wrap(body, cfg), x, stacked_params)
     return x
