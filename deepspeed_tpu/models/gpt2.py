@@ -58,6 +58,14 @@ class GPT2:
         self.config.validate(mp_size)
 
     # ------------------------------------------------------------------ init
+    def _init_blocks(self, rng):
+        """Block-stack init hook (GPT2MoE overrides with expert params)."""
+        return T.init_block_params(self.config, rng)
+
+    def _block_specs(self):
+        """Block-stack sharding hook."""
+        return T.block_partition_specs()
+
     def init_params(self, rng):
         cfg = self.config
         cfg.validate()
@@ -69,7 +77,7 @@ class GPT2:
             "wpe": jax.random.normal(
                 k_wpe, (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
             * cfg.init_std * 0.5,
-            "blocks": T.init_block_params(cfg, k_blocks),
+            "blocks": self._init_blocks(k_blocks),
             "lnf_s": jnp.ones((cfg.hidden_size,), jnp.float32),
             "lnf_b": jnp.zeros((cfg.hidden_size,), jnp.float32),
         }
@@ -78,24 +86,29 @@ class GPT2:
         return {
             "wte": P(MODEL_AXIS, None),   # vocab-parallel
             "wpe": P(),
-            "blocks": T.block_partition_specs(),
+            "blocks": self._block_specs(),
             "lnf_s": P(), "lnf_b": P(),
         }
 
     # --------------------------------------------------------------- forward
+    def _stack(self, x, blocks):
+        """Block-stack hook: returns (x, auxiliary loss term).  GPT2MoE
+        overrides this with the MoE stack + weighted load-balance loss."""
+        return T.stack_apply(x, blocks, self.config), 0.0
+
     def apply(self, params, tokens, labels):
         """tokens, labels: int32 [B, T]; labels < 0 are ignored.  Returns the
         mean per-token LM loss (fp32 scalar, local to the DP shard — the
-        engine pmean's across data)."""
+        engine pmean's across data) plus any stack auxiliary loss."""
         cfg = self.config
         T_len = tokens.shape[1]
         x = L.vocab_parallel_embedding(tokens, params["wte"])
         x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
             x.dtype)[None]
-        x = T.stack_apply(x, params["blocks"], cfg)
+        x, aux = self._stack(x, params["blocks"])
         x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
         logits = L.vocab_parallel_logits(x, params["wte"])
         loss = L.vocab_parallel_cross_entropy(logits, labels)
-        return L.masked_mean_loss(loss, labels >= 0)
+        return L.masked_mean_loss(loss, labels >= 0) + aux
 
     __call__ = apply

@@ -3,27 +3,21 @@
 Beyond-reference model family (see models/moe.py for the routing/expert
 parallelism design): every block's FFN is a capacity-routed top-1 MoE, the
 expert dim shards over the ``model`` axis, and the Switch load-balancing
-aux loss joins the LM loss with ``aux_weight``.  Engine protocol identical
-to ``GPT2`` — all parallelism/ZeRO/checkpoint subsystems compose via the
-ordinary model-sharded leaf machinery.
+aux loss joins the LM loss with ``aux_weight``.  A thin ``GPT2`` subclass:
+only the block-stack hooks differ (init/specs/forward); embeddings, the
+vocab-parallel head, and the engine protocol are inherited.
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
-
-from deepspeed_tpu.models import layers as L
 from deepspeed_tpu.models import moe as M
-from deepspeed_tpu.models.gpt2 import GPT2_SIZES
-from deepspeed_tpu.parallel.topology import MODEL_AXIS
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
 
 
 @dataclasses.dataclass
-class GPT2MoE:
+class GPT2MoE(GPT2):
     """Callable model object satisfying the engine protocol."""
     config: M.MoEConfig
 
@@ -39,45 +33,12 @@ class GPT2MoE:
                                capacity_factor=capacity_factor,
                                aux_weight=aux_weight, **kw))
 
-    def validate(self, mp_size: int = 1):
-        self.config.validate(mp_size)
+    def _init_blocks(self, rng):
+        return M.init_moe_block_params(self.config, rng)
 
-    def init_params(self, rng):
-        cfg = self.config
-        cfg.validate()
-        k_wte, k_wpe, k_blocks = jax.random.split(rng, 3)
-        return {
-            "wte": jax.random.normal(
-                k_wte, (cfg.vocab_size, cfg.hidden_size), jnp.float32)
-            * cfg.init_std,
-            "wpe": jax.random.normal(
-                k_wpe, (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-            * cfg.init_std * 0.5,
-            "blocks": M.init_moe_block_params(cfg, k_blocks),
-            "lnf_s": jnp.ones((cfg.hidden_size,), jnp.float32),
-            "lnf_b": jnp.zeros((cfg.hidden_size,), jnp.float32),
-        }
+    def _block_specs(self):
+        return M.moe_block_partition_specs()
 
-    def partition_specs(self, params=None):
-        return {
-            "wte": P(MODEL_AXIS, None),
-            "wpe": P(),
-            "blocks": M.moe_block_partition_specs(),
-            "lnf_s": P(), "lnf_b": P(),
-        }
-
-    def apply(self, params, tokens, labels):
-        """Mean LM loss + aux_weight * Switch load-balance loss."""
-        cfg = self.config
-        T_len = tokens.shape[1]
-        x = L.vocab_parallel_embedding(tokens, params["wte"])
-        x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
-            x.dtype)[None]
-        x, aux = M.moe_stack_apply(x, params["blocks"], cfg)
-        x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
-        logits = L.vocab_parallel_logits(x, params["wte"])
-        loss = L.vocab_parallel_cross_entropy(logits, labels)
-        lm = L.masked_mean_loss(loss, labels >= 0)
-        return lm + cfg.aux_weight * aux
-
-    __call__ = apply
+    def _stack(self, x, blocks):
+        x, aux = M.moe_stack_apply(x, blocks, self.config)
+        return x, self.config.aux_weight * aux

@@ -122,14 +122,15 @@ def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
     keep = (pos < cap) & (pos >= 0)
     onehot_c = jax.nn.one_hot(pos.astype(jnp.int32), cap,
                               dtype=jnp.float32) * keep[:, None]
-    dispatch = onehot_e[:, :, None] * onehot_c[:, None, :]     # [S, E, C]
-    combine = dispatch * gate[:, None, None]
 
-    # -- this shard's experts only (contiguous block of the expert dim)
+    # -- this shard's experts only: slice the expert one-hot BEFORE the
+    # outer products, so the [S, e_local, C] dispatch/combine tensors are
+    # built at 1/ep the full-E size (never materialize [S, E, C])
     shard = jax.lax.axis_index(axis) if ep > 1 else 0
     lo = shard * e_local
-    disp_local = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, axis=1)
-    comb_local = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, axis=1)
+    oe_local = jax.lax.dynamic_slice_in_dim(onehot_e, lo, e_local, axis=1)
+    disp_local = oe_local[:, :, None] * onehot_c[:, None, :]   # [S, e, C]
+    comb_local = disp_local * gate[:, None, None]
 
     # gather capacity slots, run the expert FFN batched over local experts
     ein = jnp.einsum("sec,sh->ech", disp_local, xf.astype(jnp.float32))

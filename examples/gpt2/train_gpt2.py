@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 import deepspeed_tpu
-from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.models import GPT2, GPT2MoE
 
 VOCAB, SEQ = 512, 64
 
@@ -53,12 +53,19 @@ def main():
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--size", type=str, default="tiny",
                         choices=["tiny", "small", "medium", "large"])
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="> 0 switches to GPT2MoE with this many "
+                             "experts (expert-parallel over the model axis)")
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
     deepspeed_tpu.init_distributed()   # no-op on a single host
 
-    model = GPT2.from_size(args.size, vocab_size=VOCAB, max_seq_len=SEQ)
+    if args.moe_experts > 0:
+        model = GPT2MoE.from_size(args.size, num_experts=args.moe_experts,
+                                  vocab_size=VOCAB, max_seq_len=SEQ)
+    else:
+        model = GPT2.from_size(args.size, vocab_size=VOCAB, max_seq_len=SEQ)
     engine, optimizer, _, _ = deepspeed_tpu.initialize(
         args, model=model,
         model_parameters=model.init_params(jax.random.PRNGKey(0)))
