@@ -24,14 +24,15 @@ class GPT2MoE(GPT2):
     @classmethod
     def from_size(cls, size: str, num_experts: int = 8,
                   capacity_factor: float = 1.25, aux_weight: float = 0.01,
-                  **overrides) -> "GPT2MoE":
+                  router_top_k: int = 1, **overrides) -> "GPT2MoE":
         kw = dict(GPT2_SIZES[size])
         kw.update(overrides)
         kw.setdefault("pre_ln", True)
         kw.setdefault("causal", True)
         return cls(M.MoEConfig(num_experts=num_experts,
                                capacity_factor=capacity_factor,
-                               aux_weight=aux_weight, **kw))
+                               aux_weight=aux_weight,
+                               router_top_k=router_top_k, **kw))
 
     def _init_blocks(self, rng):
         return M.init_moe_block_params(self.config, rng)

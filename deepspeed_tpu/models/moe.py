@@ -5,8 +5,11 @@ it a headline feature later); SURVEY.md §2 row 22 lists expert parallelism
 as absent on both sides.  TPU-native shape:
 
 * **Routing** is the GShard/Switch dense dispatch-combine formulation
-  (one-hot ``[S, E, C]`` tensors contracted with einsums) — static shapes,
-  MXU-friendly, no scatter/dynamic control flow.
+  (one-hot slot tensors contracted with einsums) — static shapes,
+  MXU-friendly, no scatter/dynamic control flow.  ``router_top_k=1`` gives
+  Switch (gate = raw router prob); ``router_top_k=2`` gives GShard-style
+  top-2 with gates normalized over the selected pair and sequential slot
+  assignment (second choices queue behind first choices).
 * **Expert parallelism rides the ``model`` axis**: expert-stacked FFN
   weights shard their expert dim over ``model`` (``E % mp == 0``), exactly
   like Megatron's column/row-parallel splits shard features.  Activations
@@ -21,9 +24,11 @@ as absent on both sides.  TPU-native shape:
   fraction x mean router probability), returned per block, summed by the
   scan, and added to the LM loss with ``aux_weight``.
 
-Capacity: each expert processes ``C = ceil(S / E * capacity_factor)`` slots
-per shard; overflow tokens fall through with a zero FFN delta (the residual
-connection carries them — standard Switch behavior).
+Capacity: each expert processes ``C = ceil(S * router_top_k *
+capacity_factor / E)`` slots per shard (each token occupies one slot per
+selected expert); overflow tokens fall through with a zero FFN delta for
+that choice (the residual connection carries them — standard Switch
+behavior).
 """
 
 from __future__ import annotations
@@ -45,6 +50,8 @@ class MoEConfig(T.TransformerConfig):
     num_experts: int = 8
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
+    # 1 = Switch (top-1); 2 = GShard-style top-2 with normalized gates
+    router_top_k: int = 1
 
     def validate(self, mp_size: int = 1):
         super().validate(mp_size)
@@ -52,6 +59,10 @@ class MoEConfig(T.TransformerConfig):
             raise ValueError(
                 f"num_experts {self.num_experts} not divisible by the "
                 f"model/expert-parallel degree {mp_size}")
+        if not 1 <= self.router_top_k <= self.num_experts:
+            raise ValueError(
+                f"router_top_k {self.router_top_k} must be in "
+                f"[1, num_experts={self.num_experts}]")
 
 
 def init_moe_block_params(cfg: MoEConfig, rng) -> dict:
@@ -100,37 +111,51 @@ def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
     S = B * Tk
     ep = L.axis_size_or_1(axis)
     e_local = p["exp1_w"].shape[0]
-    cap = int(-(-S * cfg.capacity_factor // E))  # ceil
+    # each token occupies router_top_k slots, so capacity scales with k
+    cap = int(-(-S * cfg.router_top_k * cfg.capacity_factor // E))  # ceil
     xf = x.reshape(S, h)
 
     # -- router (replicated compute: every shard sees every token)
     logits = (xf @ p["router_w"].astype(xf.dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)                    # [S, E]
-    expert = jnp.argmax(probs, axis=-1)                        # [S]
-    onehot_e = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [S, E]
-    gate = jnp.sum(probs * onehot_e, axis=-1)                  # [S]
+    k = cfg.router_top_k
+    topv, topi = jax.lax.top_k(probs, k)                       # [S, k]
+    gate_norm = jnp.sum(topv, axis=-1, keepdims=True)          # [S, 1]
 
-    # Switch aux loss: E * Σ_e (token fraction) · (mean prob)
-    frac = jnp.mean(onehot_e, axis=0)
-    mean_p = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac * mean_p)
+    # aux loss on the FIRST choice (Switch rule; GShard's top-2 aux also
+    # counts only the primary assignment): E * Σ_e fraction_e · mean-prob_e
+    oh0 = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+    aux = E * jnp.sum(jnp.mean(oh0, axis=0) * jnp.mean(probs, axis=0))
 
-    # capacity slots: position of each token within its expert's queue
-    # (mask BEFORE the row-sum — the -1 must apply once per token, not once
-    # per non-chosen expert column)
-    pos = jnp.sum(jnp.cumsum(onehot_e, axis=0) * onehot_e, axis=-1) - 1.0
-    keep = (pos < cap) & (pos >= 0)
-    onehot_c = jax.nn.one_hot(pos.astype(jnp.int32), cap,
-                              dtype=jnp.float32) * keep[:, None]
-
-    # -- this shard's experts only: slice the expert one-hot BEFORE the
-    # outer products, so the [S, e_local, C] dispatch/combine tensors are
-    # built at 1/ep the full-E size (never materialize [S, E, C])
+    # -- this shard's experts only: slice each choice's expert one-hot
+    # BEFORE the outer products, so dispatch/combine stay [S, e_local, C]
+    # (never materialize [S, E, C])
     shard = jax.lax.axis_index(axis) if ep > 1 else 0
     lo = shard * e_local
-    oe_local = jax.lax.dynamic_slice_in_dim(onehot_e, lo, e_local, axis=1)
-    disp_local = oe_local[:, :, None] * onehot_c[:, None, :]   # [S, e, C]
-    comb_local = disp_local * gate[:, None, None]
+    disp_local = jnp.zeros((S, e_local, cap), jnp.float32)
+    comb_local = jnp.zeros((S, e_local, cap), jnp.float32)
+    counts = jnp.zeros((E,), jnp.float32)   # slots taken by earlier choices
+    for j in range(k):
+        oh = jax.nn.one_hot(topi[:, j], E, dtype=jnp.float32)  # [S, E]
+        # slot of each token within its expert's queue: tokens of EARLIER
+        # choices occupy the head of the queue (GShard's sequential
+        # assignment); mask before the row-sum so the -1 and the offset
+        # apply once per token
+        pos = jnp.sum((jnp.cumsum(oh, axis=0) + counts[None, :] - 1.0)
+                      * oh, axis=-1)
+        keep = (pos < cap) & (pos >= 0)
+        onehot_c = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                  dtype=jnp.float32) * keep[:, None]
+        oh_local = jax.lax.dynamic_slice_in_dim(oh, lo, e_local, axis=1)
+        disp_j = oh_local[:, :, None] * onehot_c[:, None, :]   # [S, e, C]
+        disp_local = disp_local + disp_j
+        if k == 1:
+            gate_j = topv[:, 0]       # Switch: scale by the raw router prob
+        else:
+            # GShard: gates normalized over the k selected experts
+            gate_j = topv[:, j] / jnp.maximum(gate_norm[:, 0], 1e-9)
+        comb_local = comb_local + disp_j * gate_j[:, None, None]
+        counts = counts + jnp.sum(oh, axis=0)
 
     # gather capacity slots, run the expert FFN batched over local experts
     ein = jnp.einsum("sec,sh->ech", disp_local, xf.astype(jnp.float32))

@@ -78,10 +78,12 @@ def run_shardmapped(model, params, batch, mp):
     return float(loss), grads
 
 
-def test_expert_parallel_matches_single_shard():
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_expert_parallel_matches_single_shard(top_k):
     """ep=2 == ep=1: loss and every gradient leaf (expert-sharded grads
-    reassemble to the same global values)."""
-    model = tiny(num_experts=4)
+    reassemble to the same global values), for Switch (k=1) and GShard
+    top-2 routing."""
+    model = tiny(num_experts=4, router_top_k=top_k)
     params = model.init_params(jax.random.PRNGKey(0))
     batch = lm_batch(8)
     l1, g1 = run_shardmapped(model, params, batch, mp=1)
@@ -94,6 +96,63 @@ def test_expert_parallel_matches_single_shard():
         key = jax.tree_util.keystr(k)
         np.testing.assert_allclose(np.asarray(v), np.asarray(flat2[key]),
                                    rtol=2e-5, atol=2e-6, err_msg=key)
+
+
+def test_top2_gates_and_slots():
+    """Top-2: a kept token's combine weights sum to 1 (normalized over the
+    selected pair) and it occupies one slot in each of its two experts."""
+    cfg = moe_mod.MoEConfig(vocab_size=VOCAB, max_seq_len=SEQ,
+                            hidden_size=32, num_layers=1, num_heads=4,
+                            num_experts=4, capacity_factor=4.0,
+                            router_top_k=2)
+    rng = jax.random.PRNGKey(0)
+    p = jax.tree_util.tree_map(
+        lambda x: x[0], moe_mod.init_moe_block_params(cfg, rng))
+    mesh = make_mesh(model_parallel_size=1, devices=jax.devices()[:1])
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, SEQ, 32)),
+                    jnp.float32)
+
+    # capacity_factor 4.0 with k=2 → nothing dropped; probe the internals
+    # by a capacity-slot reconstruction like the kernel's
+    S = 2 * SEQ
+    xf = np.asarray(x).reshape(S, 32)
+    logits = xf @ np.asarray(p["router_w"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    top2 = np.argsort(-probs, axis=-1)[:, :2]
+
+    fn = jax.jit(jax.shard_map(
+        lambda p_, x_: moe_mod.moe_ffn(x_, p_, cfg), mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), p), P()),
+        out_specs=(P(), P()), check_vma=False))
+    y, aux = fn(p, x)
+    assert np.isfinite(float(aux))
+    # every token kept (capacity ample) → every output row nonzero, and the
+    # output equals the gate-weighted sum of its two experts' FFN outputs;
+    # cheap invariant: rows where the two top probs are far apart still get
+    # a nonzero delta (both experts contribute)
+    yf = np.asarray(y).reshape(S, 32)
+    assert (np.abs(yf).max(axis=-1) > 0).all()
+
+    # exact reference for EVERY token: y[s] = Σ_j gate_j · FFN_{e_j}(x[s])
+    # with gates normalized over the selected pair (nothing dropped at this
+    # capacity) — catches a dropped/double-counted second choice anywhere
+    def gelu(v):
+        return 0.5 * v * (1.0 + np.tanh(
+            np.sqrt(2.0 / np.pi) * (v + 0.044715 * v ** 3)))
+
+    w1, b1 = np.asarray(p["exp1_w"]), np.asarray(p["exp1_b"])
+    w2, b2 = np.asarray(p["exp2_w"]), np.asarray(p["exp2_b"])
+    for s in range(S):
+        e0, e1 = top2[s]
+        g = probs[s, [e0, e1]]
+        g = g / g.sum()
+        want = np.zeros(32, np.float64)
+        for gj, e in zip(g, (e0, e1)):
+            hmid = gelu(xf[s] @ w1[e] + b1[e])
+            want += gj * (hmid @ w2[e] + b2[e])
+        np.testing.assert_allclose(yf[s], want, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"token {s}")
 
 
 @pytest.mark.fast
