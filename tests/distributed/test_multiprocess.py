@@ -36,6 +36,14 @@ def test_zero_checkpoint_resume_multiprocess(tmpdir):
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
 
 
+def test_zero_pps_checkpoint_resume_multiprocess(tmpdir):
+    """parameter_parallel_size sub-groups across real processes: partition
+    dedup on save + resume parity (tests/test_zero_pps.py single-process
+    twin)."""
+    spawn_distributed("zero_pps_ckpt_resume", world_size=2, local_devices=2,
+                      env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
+
+
 def test_zero_mp_checkpoint_roles_multiprocess(tmpdir):
     spawn_distributed("zero_mp_ckpt_roles", world_size=2, local_devices=2,
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})

@@ -121,6 +121,46 @@ def zero_ckpt_resume():
     assert post_losses == ref_losses[4:], (post_losses, ref_losses[4:])
 
 
+# ---------------------------------------------------------------- scenario 2b
+
+def zero_pps_ckpt_resume():
+    """ZeRO with parameter_parallel_size=2 under dp=4 across 2 real
+    processes: the block-tiled flat master's write-role dedup must save
+    exactly the pps distinct partitions, and a fresh engine must resume to
+    the unbroken trajectory."""
+    cfg = dict(_ZERO_CFG)
+    cfg["zero_optimization"] = {"stage": 1, "parameter_parallel_size": 2}
+    ckdir = _test_dir()
+
+    def make_engine():
+        engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=8),
+                                        config=dict(cfg))
+        return engine
+
+    unbroken = make_engine()
+    assert unbroken.dp_world_size == 4 and unbroken.zero_pps == 2
+    ref_losses = [_step(unbroken, i) for i in range(6)]
+
+    saver = make_engine()
+    pre = [_step(saver, i) for i in range(4)]
+    assert pre == ref_losses[:4], (pre, ref_losses)  # trajectory vs ckpt bug
+    saver.save_checkpoint(ckdir, tag="pps")
+
+    files = sorted(os.listdir(os.path.join(ckdir, "pps")))
+    zero_files = [f for f in files if f.startswith("zero_pp_rank_")]
+    # only the 2 DISTINCT partitions are written (replica rows deduped)
+    assert zero_files == [
+        "zero_pp_rank_0_mp_rank_00optim_states.pt",
+        "zero_pp_rank_1_mp_rank_00optim_states.pt"], zero_files
+
+    resumed = make_engine()
+    path, _ = resumed.load_checkpoint(ckdir, tag="pps")
+    assert path is not None
+    assert resumed.global_steps == 4
+    post = [_step(resumed, i) for i in (4, 5)]
+    assert post == ref_losses[4:], (post, ref_losses[4:])
+
+
 # ---------------------------------------------------------------- scenario 3
 
 class TinyTP:
