@@ -166,3 +166,23 @@ def test_stream_supported_gate():
     assert not pattn.stream_supported(128, 64)   # below a tile
     assert not pattn.stream_supported(384, 64)   # not a tile multiple
     assert not pattn.stream_supported(512, 12)   # head dim not 8-aligned
+
+
+def test_stream_bf16_dtype_contract():
+    """bf16 inputs (the TPU training dtype): outputs/grads come back bf16
+    and match an fp32 reference within bf16 rounding."""
+    rng = np.random.default_rng(7)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, ST, SN, SD)), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    mask = jnp.ones((2, ST), jnp.float32)
+    out = pattn.stream_attention(q, k, v, mask, True, True)
+    assert out.dtype == jnp.bfloat16
+    want = stream_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                            v.astype(jnp.float32), mask, True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want), rtol=0.05, atol=0.05)
+    g = jax.grad(lambda q, k, v: jnp.sum(pattn.stream_attention(
+        q, k, v, mask, True, True).astype(jnp.float32)), (0, 1, 2))(q, k, v)
+    for a in g:
+        assert a.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(a.astype(jnp.float32))))
