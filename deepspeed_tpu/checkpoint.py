@@ -431,8 +431,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     mfile = model_file(load_dir, tag, 0)
     if not os.path.exists(mfile):
-        # pp>1 saves use per-stage file names
-        mfile = model_file(load_dir, tag, 0, 0, pp_size=2)
+        # pp>1 saves use per-stage file names; the template does not embed
+        # the pp degree, so stage 0 / mp rank 0 is the canonical probe
+        mfile = os.path.join(load_dir, tag, MODEL_FILE_PP.format(pp=0, mp=0))
         if not os.path.exists(mfile):
             return None, None
     state = _load_obj(mfile)
@@ -591,7 +592,10 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
     def stack(key):
         if rows == 1:
             return engine._tile_flat(reassemble(key, 0))
-        return np.stack([reassemble(key, m) for m in range(rows)])
+        # each composite row re-tiles for the engine's sub-group layout
+        # (no-op at pps == dp)
+        return np.stack([engine._tile_flat(reassemble(key, m))
+                         for m in range(rows)])
 
     host_master = stack("master")
     engine.master_flat = jax.device_put(jnp.asarray(host_master),

@@ -102,9 +102,14 @@ def moe_block_partition_specs() -> dict:
     return specs
 
 
-def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
+def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS, valid=None):
     """Switch FFN on local shards.  x: [B, Tk, h] model-replicated; p leaves
-    are this shard's slices (expert dim = E/ep local experts).  Returns
+    are this shard's slices (expert dim = E/ep local experts).  ``valid`` is
+    an optional [B, Tq] mask (1=real token, 0=padding; Tq may be the global
+    sequence length under sequence parallelism — it is sliced to this
+    shard's Tk).  Padding tokens are excluded from the load-balancing
+    statistics AND from dispatch, so they neither bias the router's
+    balance signal nor consume expert capacity.  Returns
     (y [B, Tk, h], aux scalar)."""
     B, Tk, h = x.shape
     E = cfg.num_experts
@@ -114,6 +119,13 @@ def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
     # each token occupies router_top_k slots, so capacity scales with k
     cap = int(-(-S * cfg.router_top_k * cfg.capacity_factor // E))  # ceil
     xf = x.reshape(S, h)
+    v = None
+    if valid is not None:
+        if L.axis_size_or_1(L.SEQ_AXIS) > 1 and valid.shape[1] != Tk:
+            # sp>1: slice the global [B, T] mask down to this shard's Tk
+            start = jax.lax.axis_index(L.SEQ_AXIS) * Tk
+            valid = jax.lax.dynamic_slice_in_dim(valid, start, Tk, axis=1)
+        v = valid.reshape(S).astype(jnp.float32)
 
     # -- router (replicated compute: every shard sees every token)
     logits = (xf @ p["router_w"].astype(xf.dtype)).astype(jnp.float32)
@@ -123,9 +135,16 @@ def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
     gate_norm = jnp.sum(topv, axis=-1, keepdims=True)          # [S, 1]
 
     # aux loss on the FIRST choice (Switch rule; GShard's top-2 aux also
-    # counts only the primary assignment): E * Σ_e fraction_e · mean-prob_e
+    # counts only the primary assignment): E * Σ_e fraction_e · mean-prob_e,
+    # with fractions/means taken over VALID positions only
     oh0 = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
-    aux = E * jnp.sum(jnp.mean(oh0, axis=0) * jnp.mean(probs, axis=0))
+    if v is None:
+        frac, pmean = jnp.mean(oh0, axis=0), jnp.mean(probs, axis=0)
+    else:
+        n = jnp.maximum(jnp.sum(v), 1.0)
+        frac = jnp.sum(oh0 * v[:, None], axis=0) / n
+        pmean = jnp.sum(probs * v[:, None], axis=0) / n
+    aux = E * jnp.sum(frac * pmean)
 
     # -- this shard's experts only: slice each choice's expert one-hot
     # BEFORE the outer products, so dispatch/combine stay [S, e_local, C]
@@ -137,6 +156,8 @@ def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
     counts = jnp.zeros((E,), jnp.float32)   # slots taken by earlier choices
     for j in range(k):
         oh = jax.nn.one_hot(topi[:, j], E, dtype=jnp.float32)  # [S, E]
+        if v is not None:
+            oh = oh * v[:, None]   # padding takes no capacity slot
         # slot of each token within its expert's queue: tokens of EARLIER
         # choices occupy the head of the queue (GShard's sequential
         # assignment); mask before the row-sum so the -1 and the offset
@@ -175,10 +196,12 @@ def moe_ffn(x, p, cfg: MoEConfig, axis=MODEL_AXIS):
 
 
 def moe_block_apply(x, p, cfg: MoEConfig, attn_mask=None):
-    """Transformer block with the FFN replaced by the Switch MoE.  Returns
-    (x, aux)."""
+    """Transformer block with the FFN replaced by the Switch MoE.  The
+    attention mask doubles as the router's validity mask (1=real, 0=pad).
+    Returns (x, aux)."""
     return T.block_with_ffn(x, p, cfg, attn_mask,
-                            ffn=lambda u, pp: moe_ffn(u, pp, cfg))
+                            ffn=lambda u, pp: moe_ffn(u, pp, cfg,
+                                                      valid=attn_mask))
 
 
 def moe_stack_apply(x, stacked_params, cfg: MoEConfig, attn_mask=None):

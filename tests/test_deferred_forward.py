@@ -183,6 +183,30 @@ def test_lazy_loss_materialization_protocols():
     engine.step()
 
 
+def test_lazy_loss_introspection_does_not_force():
+    """ADVICE r3: hasattr sweeps / debugger probes must neither force the
+    fused program nor appear to succeed; deferred losses are unhashable
+    (value-based __eq__, like jax.Array)."""
+    engine = _engine()
+    loss = engine(*random_batch(8, 10, seed=0))
+    pending = engine._pending
+    # dunder-protocol probing (copy, pickle, numpy protocol discovery)
+    assert not hasattr(loss, "__deepcopy__")
+    assert not hasattr(loss, "__array_interface__")
+    assert not hasattr(loss, "not_an_array_attr")
+    with pytest.raises(AttributeError, match="materialize"):
+        loss.totally_made_up
+    assert not pending.forced  # none of the probes ran the program
+    with pytest.raises(TypeError):
+        hash(loss)
+    assert not pending.forced
+    # whitelisted array attributes still delegate (and force)
+    assert loss.dtype == jnp.asarray(loss).dtype
+    assert pending.forced
+    engine.backward(loss)
+    engine.step()
+
+
 def test_five_span_breakdown():
     engine = _engine(wall_clock_breakdown=True)
     for seed in range(2):

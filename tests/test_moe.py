@@ -200,6 +200,45 @@ def test_dispatch_mechanics():
     assert np.abs(yf[kept]).max() > 0
 
 
+@pytest.mark.fast
+def test_router_mask_excludes_padding():
+    """With a validity mask, padding tokens neither bias the aux
+    load-balancing statistics nor consume expert capacity (ADVICE r3):
+    the masked aux over [x_valid | junk padding] equals the unmasked aux
+    over x_valid alone, and padded positions get a zero FFN delta."""
+    cfg = moe_mod.MoEConfig(vocab_size=VOCAB, max_seq_len=SEQ,
+                            hidden_size=32, num_layers=1, num_heads=4,
+                            num_experts=2, capacity_factor=0.5)
+    rng = jax.random.PRNGKey(0)
+    p = jax.tree_util.tree_map(
+        lambda x: x[0], moe_mod.init_moe_block_params(cfg, rng))
+    mesh = make_mesh(model_parallel_size=1, devices=jax.devices()[:1])
+    gen = np.random.default_rng(0)
+    x_valid = jnp.asarray(gen.normal(size=(2, SEQ // 2, 32)), jnp.float32)
+    junk = jnp.asarray(100.0 * gen.normal(size=(2, SEQ // 2, 32)),
+                       jnp.float32)
+    x_full = jnp.concatenate([x_valid, junk], axis=1)
+    valid = jnp.concatenate([jnp.ones((2, SEQ // 2)),
+                             jnp.zeros((2, SEQ // 2))], axis=1)
+
+    def run(x, mask):
+        fn = jax.jit(jax.shard_map(
+            lambda p_, x_: moe_mod.moe_ffn(x_, p_, cfg, valid=mask),
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(), p), P()),
+            out_specs=(P(), P()), check_vma=False))
+        return fn(p, x)
+
+    y_full, aux_masked = run(x_full, valid)
+    _, aux_ref = run(x_valid, None)
+    # identical valid-token set → identical per-token router stats
+    np.testing.assert_allclose(float(aux_masked), float(aux_ref), rtol=1e-6)
+    # padding rows take no slot and get exactly zero delta
+    np.testing.assert_array_equal(np.asarray(y_full[:, SEQ // 2:]), 0.0)
+    # the valid rows still produce output
+    assert np.abs(np.asarray(y_full[:, :SEQ // 2])).max() > 0
+
+
 def chain_batch(batch, seed=0):
     """Learnable corpus: next token = (tok * 7 + 3) mod V (a deterministic
     chain a 2-layer model picks up fast — random tokens would pin the loss
