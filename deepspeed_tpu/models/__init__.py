@@ -7,7 +7,8 @@ framework owns a sharded model zoo (SURVEY.md §7.1 "mpu protocol" row).
 from deepspeed_tpu.models.transformer import (TransformerConfig,
                                               init_block_params,
                                               block_partition_specs,
-                                              block_apply, stack_apply)
+                                              block_apply, stack_apply,
+                                              token_batch_specs)
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
 from deepspeed_tpu.models.pipeline_gpt2 import GPT2Pipelined
 from deepspeed_tpu.models.gpt2_moe import GPT2MoE
@@ -17,7 +18,8 @@ from deepspeed_tpu.models.bert import (BertForPreTraining,
 
 __all__ = [
     "TransformerConfig", "init_block_params", "block_partition_specs",
-    "block_apply", "stack_apply", "GPT2", "GPT2_SIZES",
+    "block_apply", "stack_apply", "token_batch_specs",
+    "GPT2", "GPT2_SIZES",
     "GPT2Pipelined", "GPT2MoE", "MoEConfig",
     "BertForPreTraining", "BertForQuestionAnswering", "BERT_SIZES",
 ]

@@ -17,7 +17,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import layers as L
 from deepspeed_tpu.models import transformer as T
-from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, MODEL_AXIS,
+                                             SEQ_AXIS)
 
 
 BERT_SIZES = {
@@ -123,6 +124,27 @@ class BertForPreTraining:
             specs.update({"pool_w": P(), "pool_b": P(),
                           "nsp_w": P(), "nsp_b": P()})
         return specs
+
+    def batch_specs(self, batch):
+        """Engine hook, format-aware (mirrors ``apply``): the first three
+        leaves and dense ``mlm_labels`` are [B, T] sequence-aligned; the
+        masked-positions leaves are [B, P] (P = max predictions, NOT the
+        sequence) and shard over ``data`` only; nsp_labels is [B]."""
+        batch = tuple(batch)
+        rest = len(batch) - 3
+        seq = P(DATA_AXIS, SEQ_AXIS)
+        specs = [seq, seq, seq]
+        if rest in (1, 2):
+            specs.append(seq)                      # dense mlm_labels [B, T]
+        elif rest in (3, 4):
+            specs += [P(DATA_AXIS, None)] * 3      # positions/ids/weights
+        else:
+            raise TypeError(
+                f"BertForPreTraining batch: expected 4-7 leaves, "
+                f"got {len(batch)}")
+        if rest in (2, 4):
+            specs.append(P(DATA_AXIS))             # nsp_labels [B]
+        return tuple(specs)
 
     def _mlm_head(self, params, h):
         """Dense + LN + tied vocab decoder on [.., H] hidden states."""
@@ -234,6 +256,12 @@ class BertForQuestionAnswering:
         specs = _backbone_partition_specs()
         specs.update({"qa_w": P(), "qa_b": P()})
         return specs
+
+    def batch_specs(self, batch):
+        """Engine hook: (ids, mask, type_ids) are [B, T]; start/end
+        positions are [B] per-example scalars."""
+        seq = P(DATA_AXIS, SEQ_AXIS)
+        return (seq, seq, seq, P(DATA_AXIS), P(DATA_AXIS))
 
     def span_logits(self, params, input_ids, attention_mask, token_type_ids):
         """(start_logits, end_logits), each [B, T] fp32 — the prediction

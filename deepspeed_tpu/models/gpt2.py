@@ -90,6 +90,11 @@ class GPT2:
             "lnf_s": P(), "lnf_b": P(),
         }
 
+    def batch_specs(self, batch):
+        """Engine hook: (tokens, labels) are both [B, T] — dim 1 is the
+        sequence, so it shards over the context-parallel ring."""
+        return T.token_batch_specs(batch)
+
     # --------------------------------------------------------------- forward
     def _stack(self, x, blocks):
         """Block-stack hook: returns (x, auxiliary loss term).  GPT2MoE

@@ -21,7 +21,29 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import layers as L
-from deepspeed_tpu.parallel.topology import MODEL_AXIS
+from deepspeed_tpu.parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def token_batch_specs(batch):
+    """Batch shardings for the standard token-aligned LM batch: every >=2-D
+    leaf is ``[B, T, ...]`` with dim 1 the sequence (tokens, labels,
+    attention masks) and shards ``P('data', 'seq')``; 1-D leaves are
+    per-example and shard ``P('data')``.  The engine REQUIRES models to
+    declare batch shardings under context parallelism (it will not guess
+    which dims are sequences); this is the declaration every [B, T] LM in
+    the built-in family uses.  All mesh axes always exist (topology
+    make_mesh), so the specs are valid at any parallel degree."""
+    import numpy as _np
+
+    def spec(leaf):
+        nd = getattr(leaf, "ndim", None)
+        if nd is None:
+            nd = _np.asarray(leaf).ndim
+        if nd >= 2:
+            return P(DATA_AXIS, SEQ_AXIS)
+        return P(DATA_AXIS) if nd >= 1 else P()
+
+    return jax.tree_util.tree_map(spec, batch)
 
 
 @dataclasses.dataclass(frozen=True)
