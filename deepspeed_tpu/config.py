@@ -115,6 +115,12 @@ class DeepSpeedConfig:
             pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
         self.pipeline_parallel_size = get_scalar_param(
             pd, C.PIPELINE_PARALLEL_SIZE, C.PIPELINE_PARALLEL_SIZE_DEFAULT)
+        self.pipeline_schedule = get_scalar_param(
+            pd, C.PIPELINE_SCHEDULE, C.PIPELINE_SCHEDULE_DEFAULT)
+        if self.pipeline_schedule not in (None, "gpipe", "1f1b"):
+            raise DeepSpeedConfigError(
+                f"{C.PIPELINE_SCHEDULE} must be 'gpipe' or '1f1b', got "
+                f"{self.pipeline_schedule!r}")
         self.sparse_gradients_max_rows = get_scalar_param(
             pd, C.SPARSE_GRADIENTS_MAX_ROWS,
             C.SPARSE_GRADIENTS_MAX_ROWS_DEFAULT)

@@ -189,6 +189,8 @@ CONTEXT_PARALLEL_SIZE_DEFAULT = 1
 MESH_PIPE_AXIS = "pipe"
 PIPELINE_PARALLEL_SIZE = "pipeline_parallel_size"
 PIPELINE_PARALLEL_SIZE_DEFAULT = 1
+PIPELINE_SCHEDULE = "pipeline_schedule"
+PIPELINE_SCHEDULE_DEFAULT = None          # None | "gpipe" | "1f1b"
 
 ZERO_PARAMETER_PARALLEL_SIZE = "parameter_parallel_size"
 ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT = None

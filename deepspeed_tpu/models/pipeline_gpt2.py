@@ -31,13 +31,21 @@ from deepspeed_tpu.parallel.topology import PIPE_AXIS
 @dataclasses.dataclass
 class GPT2Pipelined(GPT2):
     """``num_micro_batches`` micro-batches stream through the stage ring per
-    forward; the per-shard batch must divide evenly."""
+    forward; the per-shard batch must divide evenly.  ``schedule`` selects
+    the pipeline schedule: ``"gpipe"`` (all forwards, then autodiff
+    backward; head sharded over stages) or ``"1f1b"`` (interleaved
+    one-forward-one-backward with activation recompute — in-flight
+    activations bounded by ``2·pp-1`` instead of the micro-batch count;
+    the engine's ``pipeline_schedule`` config key overrides this field)."""
     num_micro_batches: int = 2
+    schedule: str = "gpipe"
 
     @classmethod
-    def from_size(cls, size: str, num_micro_batches: int = 2, **overrides):
+    def from_size(cls, size: str, num_micro_batches: int = 2,
+                  schedule: str = "gpipe", **overrides):
         base = GPT2.from_size(size, **overrides)
-        return cls(config=base.config, num_micro_batches=num_micro_batches)
+        return cls(config=base.config, num_micro_batches=num_micro_batches,
+                   schedule=schedule)
 
     def partition_specs(self, params=None):
         specs = super().partition_specs(params)
@@ -56,10 +64,38 @@ class GPT2Pipelined(GPT2):
             raise ValueError(
                 f"per-shard batch {B} not divisible by "
                 f"num_micro_batches={m}")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {self.schedule!r} "
+                "(expected 'gpipe' or '1f1b')")
         x = L.vocab_parallel_embedding(tokens, params["wte"])
         x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
             x.dtype)[None]
         x_micro = x.reshape(m, B // m, T_len, x.shape[-1])
+
+        if self.schedule == "1f1b":
+            # interleaved schedule: the per-micro head runs on the last
+            # stage inside the pipeline scan (standard 1F1B — the head is
+            # not stage-sharded on this path)
+            labels_micro = labels.reshape(m, B // m, T_len)
+            count = jnp.sum((labels >= 0).astype(jnp.float32))
+            head_params = {"lnf_s": params["lnf_s"],
+                           "lnf_b": params["lnf_b"],
+                           "wte": params["wte"]}
+
+            def stage_1f1b(blocks, u):
+                return T.stack_apply(u, blocks, cfg)
+
+            def head_1f1b(hp, y, ys):
+                h = L.layer_norm(y, hp["lnf_s"], hp["lnf_b"], cfg.ln_eps)
+                logits = L.vocab_parallel_logits(h, hp["wte"])
+                ce = L.vocab_parallel_cross_entropy(logits, ys)
+                mask = (ys >= 0).astype(jnp.float32)
+                return jnp.sum(ce * mask)
+
+            return pipe_mod.pipeline_1f1b_loss(
+                stage_1f1b, head_1f1b, params["blocks"], head_params,
+                x_micro, labels_micro, count)
 
         def stage_fn(u):
             # inside shard_map the blocks leaf is this stage's LOCAL

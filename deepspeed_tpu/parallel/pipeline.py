@@ -8,8 +8,10 @@ every stage runs the same program; micro-batches stream through a
 ``lax.scan`` over ``m + pp - 1`` ticks, each tick applying the stage's local
 blocks and handing the activation to the next stage with a ``ppermute``.
 Autodiff through ``ppermute`` (its transpose is the reverse permute) yields
-the exact pipelined backward — the 1F1B-style memory optimisation is left to
-rematerialisation of the stage blocks.
+the exact pipelined backward.  ``pipeline_1f1b_loss`` is the alternative
+1F1B schedule: forward and backward micro-steps interleave in one scan
+(custom_vjp), bounding in-flight stage inputs to a ``2·pp-1`` ring — select
+it with ``"pipeline_schedule": "1f1b"`` in the engine config.
 
 The finished micro-batches exist on the LAST stage; ``collect`` masks other
 stages to zero and ``psum``s over ``pipe``, so downstream (head/loss) math is
@@ -74,6 +76,184 @@ def pipeline_apply(x_micro: jnp.ndarray,
     # only the last stage holds real outputs; make them uniform
     outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
     return jax.lax.psum(outputs, axis)
+
+
+def pipeline_1f1b_loss(stage_fn, head_fn, blocks, head_params, x_micro,
+                       labels_micro, count_total, axis: str = PIPE_AXIS):
+    """Pipeline forward+loss with a 1F1B (one-forward-one-backward)
+    gradient schedule.
+
+    Beyond-reference (the reference v0.1.0 has no pipeline; this is the
+    memory-optimal schedule GPipe's ``pipeline_apply`` docstring deferred
+    to rematerialisation).  Primal value: the masked-mean loss over all
+    micro-batches, pipe-uniform.  Differentiating it runs the interleaved
+    schedule in ``_run_1f1b``: each of the ``m + 2(pp-1)`` ticks performs
+    one forward micro-step AND one backward micro-step per stage (either
+    may be a bubble), the backward recomputing the stage body from its
+    saved INPUT (activation recompute — the same trade ``remat='full'``
+    makes).  In-flight stage inputs are bounded by a ``min(m, 2·pp-1)``
+    ring instead of the ``m + pp - 1`` per-tick carries GPipe autodiff
+    saves — the 1F1B memory win at large micro-batch counts.
+
+    Args:
+      stage_fn: ``(blocks_local, x[mb, ...]) -> y`` — this stage's blocks.
+      head_fn:  ``(head_params, y, labels[mb, ...]) -> loss SUM`` (masked
+                sum, fp32 scalar; labels arrive with their original
+                integer dtype) — runs per micro on the last stage.
+      blocks:   pipe-sharded stacked block params (this stage's slice).
+      head_params: pipe-replicated head/embedding params (pytree).
+      x_micro:  [m, mb, ...] micro-batched activations.
+      labels_micro: [m, mb, ...] integer labels (no gradient).
+      count_total: fp32 scalar — the global valid-token count the loss
+                normalises by (computable from labels up front).
+
+    Gradient convention: emitted cotangents carry the SAME uniform
+    pp-factor as GPipe autodiff (engine._make_loss_and_grads divides by
+    pp and psums pipe-replicated leaves), so the engine composes
+    unchanged: head/input cotangents are per-stage partials (nonzero on
+    one stage only), block cotangents are exact per-stage grads — all
+    scaled by pp here.
+    """
+    lab_dtype = jnp.asarray(labels_micro).dtype
+    # labels ride through custom_vjp as fp32 (exact for token ids) so their
+    # cotangent is an ordinary zeros array instead of a float0
+    labf = jnp.asarray(labels_micro).astype(jnp.float32)
+    lab_shape = tuple(labf.shape)
+    hfn = lambda hp, y, lf: head_fn(hp, y, lf.astype(lab_dtype))
+
+    @jax.custom_vjp
+    def run(blocks, head_params, x_micro, labf, count_total):
+        return _forward_1f1b(stage_fn, hfn, axis, blocks, head_params,
+                             x_micro, labf, count_total)
+
+    def fwd(blocks, head_params, x_micro, labf, count_total):
+        return _run_1f1b(stage_fn, hfn, axis, blocks, head_params,
+                         x_micro, labf, count_total)
+
+    def bwd(res, g):
+        gblocks, ghead, dx_out = res
+        scale = jnp.asarray(g, jnp.float32) * jax.lax.axis_size(axis)
+        sc = lambda tree: jax.tree_util.tree_map(
+            lambda x: (x * scale).astype(x.dtype), tree)
+        return (sc(gblocks), sc(ghead), sc(dx_out),
+                jnp.zeros(lab_shape, jnp.float32),
+                jnp.zeros((), jnp.float32))
+
+    run.defvjp(fwd, bwd)
+    return run(blocks, head_params, x_micro, labf, count_total)
+
+
+def _forward_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
+                  labf, count_total):
+    """Forward-only sweep + per-micro head on the last stage — the cheap
+    primal for eval / non-differentiated calls."""
+    pp = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    m = x_micro.shape[0]
+    is_last = stage == pp - 1
+
+    def tick(buf, t):
+        inject = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        cur = jnp.where(stage == 0, inject, buf)
+        y = stage_fn(blocks, cur)
+        out_t = t - (pp - 1)
+        lab = jax.lax.dynamic_index_in_dim(
+            labf, jnp.clip(out_t, 0, m - 1), axis=0, keepdims=False)
+        lsum = head_fn(head_params, y, lab)
+        lsum = jnp.where(is_last & (out_t >= 0),
+                         jnp.asarray(lsum, jnp.float32), 0.0)
+        return jax.lax.ppermute(y, axis, [(i, (i + 1) % pp)
+                                          for i in range(pp)]), lsum
+
+    _, lsums = jax.lax.scan(tick, jnp.zeros_like(x_micro[0]),
+                            jnp.arange(m + pp - 1))
+    loss_sum = jax.lax.psum(jnp.sum(lsums), axis)
+    return loss_sum / jnp.maximum(count_total, 1.0)
+
+
+def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
+              labf, count_total):
+    """The interleaved schedule; returns (loss, (dblocks, dhead,
+    dx_micro)) with UNSCALED (true, per-stage partial) loss cotangents."""
+    pp = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    m = x_micro.shape[0]
+    R = min(m, 2 * pp - 1)              # in-flight stage-input ring
+    is_first = stage == 0
+    is_last = stage == pp - 1
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+    count = jnp.maximum(count_total, 1.0)
+    seed = 1.0 / count                   # d(loss)/d(per-micro loss sum)
+
+    def tick(carry, t):
+        fwd_buf, bwd_buf, ring, dx_out, gblocks, ghead, loss_sum = carry
+
+        # ---- forward sub-step: micro f enters this stage
+        f = t - stage
+        active_f = (f >= 0) & (f < m)
+        inject = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(f, 0, m - 1), axis=0, keepdims=False)
+        fin = jnp.where(is_first, inject, fwd_buf)
+        ring = jnp.where(
+            active_f,
+            jax.lax.dynamic_update_index_in_dim(
+                ring, fin, jnp.mod(f, R), axis=0),
+            ring)
+        fwd_send = stage_fn(blocks, fin)
+
+        # ---- backward sub-step: micro b leaves this stage (recompute
+        # from the saved input; on the last stage b == f, so the head's
+        # fwd+bwd run in the tick the micro finishes its forward)
+        b = t - (2 * (pp - 1) - stage)
+        active_b = (b >= 0) & (b < m)
+        xb = jax.lax.dynamic_index_in_dim(
+            ring, jnp.mod(b, R), axis=0, keepdims=False)
+        yb, pull = jax.vjp(stage_fn, blocks, xb)
+        lab = jax.lax.dynamic_index_in_dim(
+            labf, jnp.clip(b, 0, m - 1), axis=0, keepdims=False)
+        lsum, hpull = jax.vjp(
+            lambda hp, yy: jnp.asarray(head_fn(hp, yy, lab), jnp.float32),
+            head_params, yb)
+        dhead_b, dy_head = hpull(jnp.asarray(seed, jnp.float32))
+        dy = jnp.where(is_last, dy_head.astype(yb.dtype), bwd_buf)
+        dblocks_b, dxin = pull(dy)
+
+        acc_b = jnp.where(active_b, 1.0, 0.0)
+        gblocks = jax.tree_util.tree_map(
+            lambda a, g: a + acc_b * g, gblocks, dblocks_b)
+        acc_h = jnp.where(active_b & is_last, 1.0, 0.0)
+        ghead = jax.tree_util.tree_map(
+            lambda a, g: a + acc_h * g, ghead, dhead_b)
+        dx_out = jnp.where(
+            active_b & is_first,
+            jax.lax.dynamic_update_index_in_dim(
+                dx_out, dxin, jnp.clip(b, 0, m - 1), axis=0),
+            dx_out)
+        loss_sum = loss_sum + jnp.where(active_b & is_last,
+                                        lsum.astype(jnp.float32), 0.0)
+
+        fwd_buf = jax.lax.ppermute(fwd_send, axis, fwd_perm)
+        bwd_buf = jax.lax.ppermute(dxin, axis, bwd_perm)
+        return (fwd_buf, bwd_buf, ring, dx_out, gblocks, ghead,
+                loss_sum), None
+
+    zeros_like_tree = lambda tree: jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, x.dtype), tree)
+    carry0 = (
+        jnp.zeros_like(x_micro[0]),
+        jnp.zeros_like(x_micro[0]),
+        jnp.zeros((R,) + x_micro.shape[1:], x_micro.dtype),
+        jnp.zeros_like(x_micro),
+        zeros_like_tree(blocks),
+        zeros_like_tree(head_params),
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, dx_out, gblocks, ghead, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(m + 2 * (pp - 1)))
+    loss = jax.lax.psum(loss_sum, axis) / count
+    return loss, (gblocks, ghead, dx_out)
 
 
 def mask_to_last_stage(value: jnp.ndarray, axis: str = PIPE_AXIS):

@@ -110,6 +110,21 @@ def test_pipelined_training_matches_plain(pp, mp):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+def test_pipelined_with_context_parallel():
+    """pp=2 x sp=2 x dp=2 (VERDICT r3 item 4 — the engine guard is lifted):
+    the pipeline schedule streams sequence-sharded activations, ring
+    attention runs inside the stage body, and the composed trajectory
+    matches plain GPT-2."""
+    plain, pipelined = make_models()
+    ref, _ = run_engine(plain, make_mesh(devices=jax.devices()[:4]))
+    got, engine = run_engine(
+        pipelined, make_mesh(pipeline_parallel_size=2,
+                             context_parallel_size=2))
+    assert engine.pp_world_size == 2 and engine.sp_world_size == 2
+    assert engine.dp_world_size == 2
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
 def test_pipelined_fp16_and_clipping_match():
     """The fp16 loss-scale FSM and grad clipping see pipe-partial grads —
     the norm dedup and overflow agreement must keep parity with plain."""
@@ -153,6 +168,81 @@ def test_pipelined_sgd_scale_parity():
                     jax.tree_util.tree_leaves(egot.master)):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_training_matches_plain():
+    """1F1B schedule (VERDICT r3 item 5), selected through the config key:
+    trajectory == plain GPT-2, and the eval (primal, forward-only) path
+    agrees with the differentiated schedule's loss."""
+    plain, pipelined = make_models()
+    ref, _ = run_engine(plain, make_mesh(devices=jax.devices()[:4]))
+    got, engine = run_engine(
+        pipelined, make_mesh(pipeline_parallel_size=2),
+        pipeline_schedule="1f1b")
+    assert pipelined.schedule == "1f1b"  # config override reached the model
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    engine.eval()
+    toks, labels = lm_batch(8, seed=99)
+    ev = float(engine(toks, labels))
+    engine.train()
+    tr = float(engine(toks, labels))
+    assert ev == pytest.approx(tr, rel=1e-6)
+
+
+def test_1f1b_sgd_scale_and_masters_parity():
+    """SGD pins the absolute gradient scale: the custom_vjp must emit the
+    same uniform pp-factor convention as GPipe autodiff or the whole
+    trajectory shifts."""
+    plain, _ = make_models()
+    kw = dict(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=4,
+              hidden_size=32, num_heads=4)
+    p1 = GPT2Pipelined.from_size("tiny", num_micro_batches=2,
+                                 schedule="1f1b", **kw)
+    over = dict(optimizer={"type": "SGD", "params": {"lr": 0.5}})
+    ref, eref = run_engine(plain, make_mesh(devices=jax.devices()[:4]),
+                           steps=2, **over)
+    got, egot = run_engine(p1, make_mesh(pipeline_parallel_size=2),
+                           steps=2, **over)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(eref.master),
+                    jax.tree_util.tree_leaves(egot.master)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_peak_memory_below_gpipe():
+    """The point of 1F1B: at m=8 micro-batches the compiled program's temp
+    (activation) footprint is measurably below GPipe's — in-flight stage
+    inputs are a 2·pp-1 ring, not m+pp-1 saved carries."""
+    kw = dict(vocab_size=VOCAB, max_seq_len=32, num_layers=4,
+              hidden_size=64, num_heads=4)
+
+    def compiled_temp(schedule):
+        model = GPT2Pipelined.from_size("tiny", num_micro_batches=8,
+                                        schedule=schedule, **kw)
+        params = model.init_params(jax.random.PRNGKey(0))
+        mesh = make_mesh(pipeline_parallel_size=2,
+                         devices=jax.devices()[:2])
+        specs = model.partition_specs(params)
+        fn = jax.jit(jax.shard_map(
+            lambda p, t, l: jax.value_and_grad(
+                lambda q: model.apply(q, t, l))(p),
+            mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), specs), check_vma=False))
+        toks = np.zeros((16, 32), np.int32)
+        labels = np.zeros((16, 32), np.int32)
+        return fn.lower(params, toks, labels).compile() \
+                 .memory_analysis().temp_size_in_bytes
+
+    gpipe, f1b = compiled_temp("gpipe"), compiled_temp("1f1b")
+    assert f1b < 0.95 * gpipe, (f1b, gpipe)
+
+
+def test_1f1b_rejects_unknown_schedule():
+    _, pipelined = make_models()
+    pipelined.schedule = "zigzag"
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        run_engine(pipelined, make_mesh(pipeline_parallel_size=2), steps=1)
 
 
 def test_sharded_head_fallback_indivisible_batch():
