@@ -161,6 +161,46 @@ def zero_pps_ckpt_resume():
     assert post == ref_losses[4:], (post, ref_losses[4:])
 
 
+# ---------------------------------------------------------------- scenario 2c
+
+def zero_pps_mp_ckpt_resume():
+    """parameter_parallel_size=2 x mp=2 under dp=4 across 2 real processes
+    (VERDICT r3 item 9): every [S, local] row block-tiles into 2 sub-groups;
+    save must write only the 2 distinct partitions per MP rank, and a fresh
+    engine must resume to the unbroken trajectory."""
+    ckdir = _test_dir()
+    cfg = dict(_ZERO_CFG)
+    cfg["model_parallel_size"] = 2
+    cfg["zero_optimization"] = {"stage": 1, "parameter_parallel_size": 2}
+
+    def make_engine():
+        engine, _, _, _ = ds.initialize(model=TinyTP(hidden=8), config=cfg)
+        return engine
+
+    unbroken = make_engine()
+    assert unbroken.mp_world_size == 2 and unbroken.dp_world_size == 4
+    assert unbroken.zero_pps == 2 and unbroken.zero_repl == 2
+    ref_losses = [_step(unbroken, i) for i in range(5)]
+
+    saver = make_engine()
+    pre = [_step(saver, i) for i in range(3)]
+    assert pre == ref_losses[:3], (pre, ref_losses)
+    saver.save_checkpoint(ckdir, tag="ppsmp")
+
+    files = sorted(os.listdir(os.path.join(ckdir, "ppsmp")))
+    zero_files = [f for f in files if f.startswith("zero_pp_rank_")]
+    assert zero_files == sorted(
+        f"zero_pp_rank_{r}_mp_rank_{m:02d}optim_states.pt"
+        for r in range(2) for m in range(2)), zero_files
+
+    resumed = make_engine()
+    path, _ = resumed.load_checkpoint(ckdir, tag="ppsmp")
+    assert path is not None
+    assert resumed.global_steps == 3
+    post = [_step(resumed, i) for i in (3, 4)]
+    assert post == ref_losses[3:], (post, ref_losses[3:])
+
+
 # ---------------------------------------------------------------- scenario 3
 
 class TinyTP:
