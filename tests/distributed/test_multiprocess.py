@@ -97,9 +97,154 @@ E2E_SCRIPT = textwrap.dedent("""\
         engine.backward(loss)
         engine.step()
     engine.save_checkpoint(os.environ["DSTPU_E2E_CKPT"], tag="e2e")
+    print("E2E_ENV_MARKER", os.environ.get("DSTPU_EXTRA_MARKER", "<unset>"),
+          flush=True)
     print(f"E2E_OK rank={{jax.process_index()}} loss={{float(loss):.6f}}",
           flush=True)
 """)
+
+
+E2E_CONFIG = """{
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    "fp16": {"enabled": true, "loss_scale": 64.0},
+    "zero_optimization": true
+}"""
+
+FAKE_SSH = textwrap.dedent("""\
+    #!/bin/sh
+    # test double: record the exact ssh invocation, then run the remote
+    # command locally (same machine stands in for the remote host).  The
+    # master-addr probe is answered with a fixed loopback IP so the test
+    # is hermetic on hosts where `hostname -I` is empty.
+    echo "SSH_ARGV $*" >> {log}
+    shift
+    if [ "$*" = "hostname -I" ]; then
+        echo 127.0.0.1
+        exit 0
+    fi
+    exec sh -c "$*"
+""")
+
+FAKE_PDSH = textwrap.dedent("""\
+    #!/bin/sh
+    echo "PDSH_ARGV $*" >> {log}
+    echo "PDSH_RCMD=$PDSH_RCMD_TYPE" >> {log}
+    exit 0
+""")
+
+
+def _fanout_env(tmpdir, bindir):
+    env = worker_env(pid=0, world_size=1, port=free_port(),
+                     local_devices=1)
+    env["PATH"] = str(bindir) + os.pathsep + env["PATH"]
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for var in ("DSTPU_COORDINATOR", "DSTPU_NUM_PROCESSES",
+                "DSTPU_PROCESS_ID"):
+        env.pop(var, None)
+    return env
+
+
+def test_dst_ssh_launcher_end_to_end(tmpdir):
+    """`dst --launcher ssh` against a 2-host hostfile with a recording fake
+    ssh that executes remote commands locally (VERDICT r3 item 7): the
+    full fan-out path runs — master resolution via `ssh host hostname -I`,
+    per-host command assembly with the env allowlist and `.deepspeed_env`
+    injection, rendezvous, ZeRO training, and checkpoint write."""
+    bindir = tmpdir.mkdir("bin")
+    ssh_log = tmpdir.join("ssh.log")
+    fake = bindir.join("ssh")
+    fake.write(FAKE_SSH.format(log=str(ssh_log)))
+    os.chmod(str(fake), 0o755)
+
+    script = tmpdir.join("train_e2e.py")
+    script.write(E2E_SCRIPT.format(repo=REPO))
+    cfg = tmpdir.join("ds_config.json")
+    cfg.write(E2E_CONFIG)
+    hostfile = tmpdir.join("hostfile")
+    hostfile.write("nodeA slots=1\nnodeB slots=1\n")
+    tmpdir.join(".deepspeed_env").write("DSTPU_EXTRA_MARKER=via_env_file\n")
+    ckdir = tmpdir.mkdir("ckpt")
+    port = free_port()
+
+    # _fanout_env already sets JAX_PLATFORMS/XLA_FLAGS (allowlist-exported
+    # to the "remote" side) and PALLAS_AXON_POOL_IPS="" — the latter is NOT
+    # in EXPORT_ENVS and reaches the training procs only because the fake
+    # ssh inherits this local environment
+    env = _fanout_env(tmpdir, bindir)
+    env["DSTPU_E2E_CKPT"] = str(ckdir)
+
+    cmd = [sys.executable, os.path.join(REPO, "bin", "dst"),
+           "--hostfile", str(hostfile), "--launcher", "ssh",
+           f"--master_port={port}",
+           str(script), "--deepspeed", f"--deepspeed_config={cfg}"]
+    proc = subprocess.run(cmd, env=env, cwd=str(tmpdir),
+                          capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"dst exited {proc.returncode}:\n{out}"
+    for rank in (0, 1):
+        assert f"E2E_OK rank={rank}" in out, \
+            f"rank {rank} sentinel missing:\n{out}"
+
+    log = ssh_log.read()
+    lines = [l for l in log.splitlines() if l.startswith("SSH_ARGV")]
+    # 1 master-addr probe + 2 fan-out commands, reference
+    # deepspeed_run.py:254-261 + :290-332.  The probe runs before the
+    # fan-out, but the two concurrent fan-out children may log in either
+    # order — match them by host, not position.
+    assert lines[0].startswith("SSH_ARGV nodeA hostname -I"), lines[0]
+    fan = {l.split()[1]: l for l in lines[1:]}
+    assert sorted(fan) == ["nodeA", "nodeB"], log
+    for rank, host in enumerate(("nodeA", "nodeB")):
+        line = fan[host]
+        assert f"--node_rank={rank}" in line, line
+        assert "-m deepspeed_tpu.launcher.launch" in line, line
+        assert "--world_info=" in line, line
+        # env allowlist propagation (XLA_/JAX_/PYTHON prefixes)
+        assert "export XLA_FLAGS=" in line, line
+        assert "export JAX_PLATFORMS=" in line, line
+        assert "export PYTHONPATH=" in line, line
+        # .deepspeed_env pickup from the launch cwd
+        assert "export DSTPU_EXTRA_MARKER=via_env_file" in line, line
+        assert f"cd {tmpdir}" in line, line
+    # the env-file export reached the training processes
+    assert "E2E_ENV_MARKER via_env_file" in out
+
+
+def test_dst_pdsh_command_assembly(tmpdir):
+    """`dst --launcher pdsh` with a recording fake pdsh: asserts the exact
+    fan-out command line — host list, fan-out width, %n node-rank
+    placeholder, allowlist exports, ssh rcmd type (reference
+    deepspeed_run.py:290-305)."""
+    bindir = tmpdir.mkdir("bin")
+    log = tmpdir.join("pdsh.log")
+    fake = bindir.join("pdsh")
+    fake.write(FAKE_PDSH.format(log=str(log)))
+    os.chmod(str(fake), 0o755)
+
+    hostfile = tmpdir.join("hostfile")
+    hostfile.write("nodeA slots=1\nnodeB slots=1\n")
+    script = tmpdir.join("noop.py")
+    script.write("print('never runs')\n")
+
+    env = _fanout_env(tmpdir, bindir)
+    cmd = [sys.executable, os.path.join(REPO, "bin", "dst"),
+           "--hostfile", str(hostfile), "--launcher", "pdsh",
+           "--master_addr", "127.0.0.1",
+           str(script), "--flag", "value"]
+    proc = subprocess.run(cmd, env=env, cwd=str(tmpdir),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    rec = log.read()
+    assert "PDSH_RCMD=ssh" in rec, rec
+    line = [l for l in rec.splitlines() if l.startswith("PDSH_ARGV")][0]
+    assert line.startswith("PDSH_ARGV -f 1024 -w nodeA,nodeB "), line
+    assert "--node_rank=%n" in line, line
+    assert "-m deepspeed_tpu.launcher.launch" in line, line
+    assert "export PATH=" in line, line
+    assert f"cd {tmpdir}" in line, line
+    assert "--flag value" in line.replace("'", ""), line
 
 
 def test_dst_local_launcher_end_to_end(tmpdir):
@@ -110,24 +255,13 @@ def test_dst_local_launcher_end_to_end(tmpdir):
     script = tmpdir.join("train_e2e.py")
     script.write(E2E_SCRIPT.format(repo=REPO))
     cfg = tmpdir.join("ds_config.json")
-    cfg.write("""{
-        "train_batch_size": 8,
-        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
-        "fp16": {"enabled": true, "loss_scale": 64.0},
-        "zero_optimization": true
-    }""")
+    cfg.write(E2E_CONFIG)
     ckdir = tmpdir.mkdir("ckpt")
     port = free_port()
 
-    env = worker_env(pid=0, world_size=1, port=port, local_devices=2,
-                     extra={"DSTPU_E2E_CKPT": str(ckdir)})
-    # the repo isn't pip-installed in the test environment; `dst` (and the
-    # launcher module it spawns) must still resolve deepspeed_tpu
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # dst itself must not pre-claim a rank — the launcher assigns them
-    for var in ("DSTPU_COORDINATOR", "DSTPU_NUM_PROCESSES",
-                "DSTPU_PROCESS_ID"):
-        env.pop(var, None)
+    env = _fanout_env(tmpdir, tmpdir)   # no fake binaries on PATH needed
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["DSTPU_E2E_CKPT"] = str(ckdir)
 
     cmd = [sys.executable, os.path.join(REPO, "bin", "dst"),
            "--launcher", "local", "--num_chips", "2",
