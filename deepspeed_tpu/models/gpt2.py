@@ -34,6 +34,9 @@ GPT2_SIZES = {
     "medium": dict(num_layers=24, hidden_size=1024, num_heads=16),
     "large":  dict(num_layers=24, hidden_size=1536, num_heads=16),
     "xl-1.5b": dict(num_layers=48, hidden_size=1600, num_heads=25),
+    # the reference's perf-test 1.5B shape (run_perf_test.py:18-31 uses 16
+    # heads, not the published 25, so tensor parallelism divides evenly)
+    "xl-1.5b-perf": dict(num_layers=48, hidden_size=1600, num_heads=16),
     "4b":     dict(num_layers=64, hidden_size=2304, num_heads=24),
     "8b":     dict(num_layers=72, hidden_size=3072, num_heads=24),
     "20b":    dict(num_layers=111, hidden_size=3808, num_heads=32),

@@ -8,6 +8,16 @@ data axis.
     python examples/gpt2/train_gpt2.py \
         --deepspeed_config examples/gpt2/ds_config.json --steps 100
 
+Reference-scale perf configs (run_perf_test.py analogs; need the matching
+chip count):
+
+    python examples/gpt2/train_gpt2.py --size xl-1.5b-perf \
+        --seq 1024 --vocab 50304 \
+        --deepspeed_config examples/gpt2/ds_config_perf_1_5b.json
+    python examples/gpt2/train_gpt2.py --size 4b --seq 1024 \
+        --vocab 50304 --micro-batches 2 \
+        --deepspeed_config examples/gpt2/ds_config_perf_4b.json
+
 Multi-host: bin/dst --hostfile <hf> examples/gpt2/train_gpt2.py ...
 """
 
@@ -49,23 +59,39 @@ def synthetic_lm_batch(rng, batch):
 
 
 def main():
+    global VOCAB, SEQ
+    from deepspeed_tpu.models import GPT2_SIZES
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--size", type=str, default="tiny",
-                        choices=["tiny", "small", "medium", "large"])
+                        choices=sorted(GPT2_SIZES))
+    parser.add_argument("--seq", type=int, default=SEQ,
+                        help="sequence length (perf configs use 1024)")
+    parser.add_argument("--vocab", type=int, default=VOCAB)
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="> 0 switches to GPT2MoE with this many "
                              "experts (expert-parallel over the model axis)")
+    parser.add_argument("--micro-batches", type=int, default=0,
+                        help="> 0 switches to GPT2Pipelined (pair with "
+                             "pipeline_parallel_size in the config, e.g. "
+                             "ds_config_perf_4b.json)")
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
     deepspeed_tpu.init_distributed()   # no-op on a single host
 
+    VOCAB, SEQ = args.vocab, args.seq
+    kw = dict(vocab_size=VOCAB, max_seq_len=SEQ)
     if args.moe_experts > 0:
         model = GPT2MoE.from_size(args.size, num_experts=args.moe_experts,
-                                  vocab_size=VOCAB, max_seq_len=SEQ)
+                                  **kw)
+    elif args.micro_batches > 0:
+        from deepspeed_tpu.models import GPT2Pipelined
+        model = GPT2Pipelined.from_size(
+            args.size, num_micro_batches=args.micro_batches, **kw)
     else:
-        model = GPT2.from_size(args.size, vocab_size=VOCAB, max_seq_len=SEQ)
+        model = GPT2.from_size(args.size, **kw)
     engine, optimizer, _, _ = deepspeed_tpu.initialize(
         args, model=model,
         model_parameters=model.init_params(jax.random.PRNGKey(0)))
