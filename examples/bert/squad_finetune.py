@@ -6,10 +6,12 @@ span head through the engine, report ``bert_squad_progress: step=N
 loss=...`` lines (the shape the reference's test greps), and evaluate
 EM/F1 at the end.
 
-* With ``--train-file/--predict-file`` pointing at SQuAD v1.1 JSON, a
-  whitespace tokenizer + on-the-fly vocab featurize (question, context)
-  pairs (no external tokenizer downloads); predictions map back to context
-  words and score with the official normalization (metrics.text_f1).
+* With ``--train-file/--predict-file`` pointing at SQuAD v1.1 JSON, the
+  self-contained wordpiece pipeline featurizes the data: a vocabulary is
+  trained in-process from the training corpus (``--vocab-file`` loads a
+  saved one instead; ``--save-vocab`` writes it), contexts tokenize with
+  character offsets, and predictions map back to exact context substrings
+  scored with the official evaluate-v1.1 normalization.  No downloads.
 * Without files, a synthetic answerable-span corpus runs anywhere:
 
     python examples/bert/squad_finetune.py \
@@ -33,73 +35,9 @@ import jax
 import numpy as np
 
 import deepspeed_tpu
-from deepspeed_tpu import metrics
+from deepspeed_tpu import metrics, squad
 from deepspeed_tpu.models import BertForQuestionAnswering
-
-PAD, CLS, SEP, UNK = 0, 1, 2, 3
-
-
-# ----------------------------------------------------------- real SQuAD path
-
-def load_squad(path, seq_len, vocab, limit=None):
-    """(features, answers, n_dropped): whitespace-tokenized
-    [CLS] q [SEP] ctx windows with start/end word positions mapped into the
-    window; ``n_dropped`` counts answers falling outside the context
-    window (no striding)."""
-    with open(path) as f:
-        data = json.load(f)["data"]
-    feats, answers = [], []
-    dropped = 0
-    for article in data:
-        for para in article["paragraphs"]:
-            ctx_words = para["context"].split()
-            for qa in para["qas"]:
-                if not qa.get("answers"):
-                    continue
-                ans = qa["answers"][0]
-                # char offset -> word index; an answer starting mid-word
-                # ('$400' with answer_start at the '4') belongs to the
-                # PRECEDING split word, not the next one
-                upto = para["context"][:ans["answer_start"]]
-                ws = len(upto.split())
-                if upto and not upto[-1].isspace():
-                    ws = max(0, ws - 1)
-                alen = max(1, len(ans["text"].split()))
-                q_words = qa["question"].split()[:seq_len // 4]
-                ctx_budget = seq_len - len(q_words) - 3
-                if ws + alen > ctx_budget:
-                    dropped += 1
-                    continue  # answer outside the window (no striding)
-                ids = [CLS] + [vocab(w) for w in q_words] + [SEP]
-                off = len(ids)
-                ids += [vocab(w) for w in ctx_words[:ctx_budget]] + [SEP]
-                ids = ids[:seq_len] + [PAD] * (seq_len - len(ids))
-                tt = [0] * off + [1] * (seq_len - off)
-                attn = [1 if t != PAD else 0 for t in ids]
-                feats.append((np.array(ids, np.int32),
-                              np.array(attn, np.int32),
-                              np.array(tt, np.int32),
-                              np.int32(off + ws),
-                              np.int32(off + ws + alen - 1)))
-                answers.append((ctx_words, off,
-                                [a["text"] for a in qa["answers"]]))
-                if limit and len(feats) >= limit:
-                    return feats, answers, dropped
-    return feats, answers, dropped
-
-
-class Vocab:
-    def __init__(self, size):
-        self.size = size
-        self.table = {}
-
-    def __call__(self, word):
-        w = word.lower()
-        if w not in self.table:
-            if len(self.table) + 4 >= self.size:
-                return UNK
-            self.table[w] = 4 + len(self.table)
-        return self.table[w]
+from deepspeed_tpu.tokenization import BertTokenizer, Vocab, train_wordpiece
 
 
 # ----------------------------------------------------------- synthetic path
@@ -120,8 +58,15 @@ def synthetic_batch(rng, batch, seq_len, vocab_size):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=150)
-    parser.add_argument("--seq-len", type=int, default=64)
-    parser.add_argument("--vocab-size", type=int, default=8192)
+    parser.add_argument("--seq-len", type=int, default=None,
+                        help="default: 384 with SQuAD files, 64 synthetic")
+    parser.add_argument("--doc-stride", type=int, default=128)
+    parser.add_argument("--vocab-size", type=int, default=8192,
+                        help="wordpiece vocabulary size to train")
+    parser.add_argument("--vocab-file",
+                        help="load a saved vocab.txt instead of training")
+    parser.add_argument("--save-vocab",
+                        help="write the trained vocabulary here")
     parser.add_argument("--max-answer-len", type=int, default=30)
     parser.add_argument("--train-file", help="SQuAD v1.1 train json")
     parser.add_argument("--predict-file", help="SQuAD v1.1 dev json")
@@ -134,9 +79,36 @@ def main():
             "during training; evaluating an untrained model on real SQuAD "
             "is not meaningful)")
     real = bool(args.train_file)
-    vocab_size = args.vocab_size if real else 128
+    seq_len = args.seq_len or (384 if real else 64)
+
+    if real:
+        train_exs = squad.load_squad_json(args.train_file)
+        if not train_exs:
+            raise RuntimeError(
+                f"{args.train_file} contains no answerable questions "
+                "(qas entries need non-empty 'answers'); SQuAD v1.1 "
+                "format required")
+        if args.vocab_file:
+            vocab = Vocab.load(args.vocab_file)
+        else:
+            print(f"training a {args.vocab_size}-piece wordpiece "
+                  f"vocabulary from {len(train_exs)} examples ...")
+            # paragraphs repeat once per question — dedupe for the trainer
+            corpus = list(dict.fromkeys(e.context for e in train_exs))
+            vocab = train_wordpiece(
+                corpus + [e.question for e in train_exs],
+                vocab_size=args.vocab_size)
+        if args.save_vocab:
+            vocab.save(args.save_vocab)
+        tokenizer = BertTokenizer(vocab)
+        vocab_size = len(vocab)
+        # pad vocab to the TP-divisibility the engine checks (vocab % 8)
+        vocab_size += (-vocab_size) % 8
+    else:
+        vocab_size = 128
+
     model = BertForQuestionAnswering.from_size(
-        "tiny", vocab_size=vocab_size, max_seq_len=args.seq_len,
+        "tiny", vocab_size=vocab_size, max_seq_len=seq_len,
         num_layers=4, hidden_size=128, num_heads=4)
     engine, _, _, _ = deepspeed_tpu.initialize(
         args, model=model,
@@ -146,31 +118,19 @@ def main():
                   * engine.gradient_accumulation_steps())
 
     if real:
-        vocab = Vocab(vocab_size)
-        feats, _, dropped = load_squad(args.train_file, args.seq_len, vocab)
-        if not feats:
-            raise RuntimeError(
-                f"no {args.train_file} examples fit the --seq-len "
-                f"{args.seq_len} context window ({dropped} dropped); "
-                f"raise --seq-len")
-        if dropped:
-            print(f"load_squad: {dropped} answers fell outside the "
-                  f"--seq-len {args.seq_len} window and were dropped "
-                  f"({len(feats)} kept)")
-        order = np.random.default_rng(0).permutation(len(feats))
-        def batches():
-            i = 0
-            while True:
-                take = [feats[order[(i + k) % len(feats)]]
-                        for k in range(batch_size)]
-                i += batch_size
-                yield tuple(np.stack([f[j] for f in take])
-                            for j in range(5))
-        gen = batches()
-        next_batch = lambda: next(gen)
+        feats = squad.featurize(train_exs, tokenizer, seq_len=seq_len,
+                                doc_stride=args.doc_stride)
+        n_ans = sum(f.has_answer for f in feats)
+        print(f"featurized {len(train_exs)} examples -> {len(feats)} "
+              f"windows ({n_ans} containing their answer)")
+        order = np.random.default_rng(0)
+
+        def next_batch():
+            take = order.choice(len(feats), size=batch_size, replace=True)
+            return squad.batch_features([feats[i] for i in take])
     else:
         rng = np.random.default_rng(0)
-        next_batch = lambda: synthetic_batch(rng, batch_size, args.seq_len,
+        next_batch = lambda: synthetic_batch(rng, batch_size, seq_len,
                                              vocab_size)
 
     for step in range(args.steps):
@@ -182,42 +142,38 @@ def main():
 
     predict = metrics.make_span_predictor(model, engine.params)
     if real and args.predict_file:
-        feats, answers, dev_dropped = load_squad(
-            args.predict_file, args.seq_len, vocab, limit=2048)
-        if not feats:
-            raise RuntimeError(
-                f"no {args.predict_file} examples fit the --seq-len "
-                f"{args.seq_len} context window ({dev_dropped} dropped); "
-                f"raise --seq-len")
-        # batched prediction: one dispatch per 32 examples, padded by
+        dev_exs = squad.load_squad_json(args.predict_file, limit=2048)
+        dev_feats = squad.featurize(dev_exs, tokenizer, seq_len=seq_len,
+                                    doc_stride=args.doc_stride)
+        # batched prediction: one dispatch per 32 windows, padded by
         # repeating the last feature (padding rows are sliced off)
-        em = f1 = 0.0
         eb = 32
-        for lo in range(0, len(feats), eb):
-            chunk = feats[lo:lo + eb]
+        all_ps = np.zeros(len(dev_feats), np.int64)
+        all_pe = np.zeros(len(dev_feats), np.int64)
+        all_scores = np.zeros(len(dev_feats), np.float32)
+        for lo in range(0, len(dev_feats), eb):
+            chunk = dev_feats[lo:lo + eb]
             pad = eb - len(chunk)
-            batch = chunk + [chunk[-1]] * pad
-            ids, attn, tt = (np.stack([f[j] for f in batch])
-                             for j in range(3))
+            rows = chunk + [chunk[-1]] * pad
+            ids, attn, tt, _, _ = squad.batch_features(rows)
             sl, el = predict(ids, attn, tt)
             ps, pe = metrics.best_spans(sl, el, attn, args.max_answer_len)
-            for k, (ctx_words, off, golds) in enumerate(
-                    answers[lo:lo + eb]):
-                s, e = int(ps[k]) - off, int(pe[k]) - off
-                pred = " ".join(ctx_words[max(s, 0):max(e + 1, 0)])
-                em += metrics.metric_max_over_ground_truths(
-                    metrics.text_exact_match, pred, golds)
-                f1 += metrics.metric_max_over_ground_truths(
-                    metrics.text_f1, pred, golds)
-        n = len(feats)
-        print(json.dumps({"exact_match": 100.0 * em / n,
-                          "f1": 100.0 * f1 / n, "total": n}))
+            sl, el = np.asarray(sl), np.asarray(el)
+            take = len(chunk)
+            all_ps[lo:lo + take] = ps[:take]
+            all_pe[lo:lo + take] = pe[:take]
+            all_scores[lo:lo + take] = (
+                sl[np.arange(take), ps[:take]]
+                + el[np.arange(take), pe[:take]])
+        preds = squad.postprocess(dev_exs, dev_feats, all_ps, all_pe,
+                                  all_scores)
+        print(json.dumps(squad.evaluate_predictions(dev_exs, preds)))
     else:
         eval_rng = np.random.default_rng(999)
         agg_em = agg_f1 = total = 0.0
         for _ in range(4):
             ids, attn, tt, gs, ge = synthetic_batch(
-                eval_rng, 32, args.seq_len, vocab_size)
+                eval_rng, 32, seq_len, vocab_size)
             sl, el = predict(ids, attn, tt)
             ps, pe = metrics.best_spans(sl, el, attn, max_answer_len=8)
             r = metrics.evaluate_spans(ps, pe, gs, ge)

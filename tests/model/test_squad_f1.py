@@ -1,12 +1,21 @@
-"""SQuAD-style fine-tune-to-F1 harness (BingBertSquad analog).
+"""SQuAD fine-tune-to-F1 harness on REAL text (BingBertSquad analog).
 
 BASELINE.md's north star is wall-clock to *F1 parity*; the reference ships
 a fine-tune suite asserting EM/F1 after a SQuAD run
 (/root/reference/tests/model/BingBertSquad/BingBertSquad_run_func_test.py:14-30,
-run_BingBertSquad.sh).  Synthetic answerable-span corpus here (real SQuAD
-files wire through examples/bert/squad_finetune.py): the engine fine-tune
-must reach high F1 and land within 1 point of a plain-JAX fp32 baseline.
+run_BingBertSquad.sh).  This tier runs the full real-text pipeline that
+the reference's suite exercises — wordpiece tokenization (vocab trained
+in-process, no downloads), [CLS] q [SEP] ctx windows with character
+offsets, span prediction mapped back to context SUBSTRINGS, official
+evaluate-v1.1 normalization — on the in-repo natural-language corpus
+``data/squad_mini.json``.  The engine fine-tune must reach high text-F1
+and land within 1 point of a plain-JAX fp32 baseline.
+
+The earlier synthetic-marker task (answer flagged by in-band tokens) is
+demoted to a training smoke test at the bottom of the file.
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -15,64 +24,60 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import deepspeed_tpu
-from deepspeed_tpu import metrics
+from deepspeed_tpu import metrics, squad
 from deepspeed_tpu.models import BertForQuestionAnswering
 from deepspeed_tpu.ops import optim as optim_mod
 from deepspeed_tpu.parallel.topology import make_mesh
+from deepspeed_tpu.tokenization import BertTokenizer, train_wordpiece
 
-VOCAB, SEQ, BATCH, STEPS = 128, 32, 16, 150
+DATA = os.path.join(os.path.dirname(__file__), "data", "squad_mini.json")
+VOCAB_SIZE, SEQ, BATCH, STEPS = 768, 160, 16, 300
 
 
-def model_fn():
+def model_fn(vocab_size):
     return BertForQuestionAnswering.from_size(
-        "tiny", vocab_size=VOCAB, max_seq_len=SEQ, num_layers=2,
+        "tiny", vocab_size=vocab_size, max_seq_len=SEQ, num_layers=2,
         hidden_size=64, num_heads=4)
 
 
-def qa_batch(rng, batch=BATCH):
-    """Answerable spans marked in-band: token 1 opens, token 2 closes."""
-    ids = rng.integers(4, VOCAB, size=(batch, SEQ)).astype(np.int32)
-    start = rng.integers(1, SEQ - 4, size=(batch,)).astype(np.int32)
-    end = (start + 2).astype(np.int32)
-    for b in range(batch):
-        ids[b, start[b]] = 1
-        ids[b, end[b]] = 2
-    attn = np.ones_like(ids)
-    tt = np.zeros_like(ids)
-    return ids, attn, tt, start, end
-
-
 @pytest.fixture(scope="module")
-def corpus():
-    rng = np.random.default_rng(0)
-    train = [qa_batch(rng) for _ in range(STEPS)]
-    eval_rng = np.random.default_rng(10_000)
-    dev = [qa_batch(eval_rng, batch=32) for _ in range(4)]
-    return train, dev
+def pipeline():
+    """(examples, tokenizer, features): the real-text data pipeline."""
+    exs = squad.load_squad_json(DATA)
+    corpus = list(dict.fromkeys(e.context for e in exs))  # dedupe paras
+    vocab = train_wordpiece(corpus + [e.question for e in exs],
+                            vocab_size=VOCAB_SIZE)
+    tok = BertTokenizer(vocab)
+    feats = squad.featurize(exs, tok, seq_len=SEQ, doc_stride=40)
+    return exs, tok, feats
 
 
-def evaluate_f1(model, params, dev):
-    """EM/F1 over the dev set via the span-prediction path."""
+def train_batches(feats, steps=STEPS, batch=BATCH, seed=0):
+    order = np.random.default_rng(seed)
+    idx = np.arange(len(feats))
+    for _ in range(steps):
+        take = order.choice(idx, size=batch, replace=True)
+        yield squad.batch_features([feats[i] for i in take])
+
+
+def evaluate_text_f1(model, params, exs, feats):
+    """Predict spans, map back to context text, official normalization."""
     predict = metrics.make_span_predictor(model, params)
-    agg = {"exact_match": 0.0, "f1": 0.0, "total": 0}
-    for ids, attn, tt, start, end in dev:
-        sl, el = predict(ids, attn, tt)
-        ps, pe = metrics.best_spans(sl, el, attn, max_answer_len=8)
-        r = metrics.evaluate_spans(ps, pe, start, end)
-        w = r["total"]
-        agg["exact_match"] += r["exact_match"] * w
-        agg["f1"] += r["f1"] * w
-        agg["total"] += w
-    agg["exact_match"] /= agg["total"]
-    agg["f1"] /= agg["total"]
-    return agg
+    ids, attn, tt, _, _ = squad.batch_features(feats)
+    sl, el = predict(ids, attn, tt)
+    ps, pe = metrics.best_spans(sl, el, attn, max_answer_len=24)
+    sl, el = np.asarray(sl), np.asarray(el)
+    scores = (sl[np.arange(len(feats)), ps]
+              + el[np.arange(len(feats)), pe])
+    preds = squad.postprocess(exs, feats, ps, pe, scores)
+    return squad.evaluate_predictions(exs, preds)
 
 
 @pytest.fixture(scope="module")
-def baseline_f1(corpus):
+def baseline_f1(pipeline):
     """Plain-JAX fp32 Adam fine-tune of the same model/data."""
-    train, dev = corpus
-    model = model_fn()
+    exs, tok, feats = pipeline
+    model = model_fn(len(tok.vocab))
     params = jax.tree_util.tree_map(
         lambda x: jnp.asarray(x, jnp.float32),
         model.init_params(jax.random.PRNGKey(1)))
@@ -91,16 +96,30 @@ def baseline_f1(corpus):
         local, mesh=mesh,
         in_specs=(rep(params), rep(state)) + (P(),) * 5,
         out_specs=(rep(params), rep(state), P()), check_vma=False))
-    for batch in train:
+    for batch in train_batches(feats):
         params, state, _ = step(params, state, *batch)
-    return evaluate_f1(model, params, dev)
+    return evaluate_text_f1(model, params, exs, feats)
 
 
-def test_engine_finetune_reaches_baseline_f1(corpus, baseline_f1):
-    """Engine fine-tune (bf16) F1 within 1 point of the fp32 baseline —
-    the reference suite's pass criterion shape."""
-    train, dev = corpus
-    model = model_fn()
+def test_real_text_pipeline_oracle(pipeline):
+    """Gold token spans must map back to answer text at F1 ~100 — pins
+    the tokenizer offsets, window mapping, and normalization end to end
+    before any model enters the picture."""
+    exs, _, feats = pipeline
+    starts = np.array([f.start_position for f in feats])
+    ends = np.array([f.end_position for f in feats])
+    scores = np.array([1.0 if f.has_answer else -1.0 for f in feats])
+    preds = squad.postprocess(exs, feats, starts, ends, scores)
+    r = squad.evaluate_predictions(exs, preds)
+    assert r["f1"] > 99.0 and r["exact_match"] > 95.0, r
+
+
+def test_engine_finetune_reaches_baseline_f1(pipeline, baseline_f1):
+    """Engine fine-tune (bf16) text-F1 within 1 point of the fp32
+    baseline — the reference suite's pass criterion shape, now on real
+    text with the official normalization."""
+    exs, tok, feats = pipeline
+    model = model_fn(len(tok.vocab))
     engine, _, _, _ = deepspeed_tpu.initialize(
         config={"train_batch_size": BATCH,
                 "steps_per_print": 10 ** 6,
@@ -109,40 +128,13 @@ def test_engine_finetune_reaches_baseline_f1(corpus, baseline_f1):
         model=model,
         model_parameters=model.init_params(jax.random.PRNGKey(1)),
         mesh=make_mesh(model_parallel_size=1))
-    for batch in train:
+    for batch in train_batches(feats):
         engine.train_batch(batch)
-    got = evaluate_f1(model, engine.params, dev)
-    assert baseline_f1["f1"] > 90.0, baseline_f1
+    got = evaluate_text_f1(model, engine.params, exs, feats)
+    assert baseline_f1["f1"] > 85.0, baseline_f1
     assert got["f1"] > baseline_f1["f1"] - 1.0, (got, baseline_f1)
     assert got["exact_match"] > baseline_f1["exact_match"] - 2.0, (
         got, baseline_f1)
-
-
-def test_load_squad_midword_answer_offset(tmp_path):
-    """Answers starting mid-word ('$400' with answer_start at the '4')
-    must map to the containing split word, not the following one."""
-    import importlib.util
-    import json
-    import os
-    spec = importlib.util.spec_from_file_location(
-        "squad_finetune", os.path.join(
-            os.path.dirname(__file__), "..", "..", "examples", "bert",
-            "squad_finetune.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    ctx = "It cost $400 million total"
-    data = {"data": [{"paragraphs": [{"context": ctx, "qas": [
-        {"id": "q0", "question": "how much",
-         "answers": [{"text": "400", "answer_start": ctx.index("400")}]},
-    ]}]}]}
-    p = tmp_path / "mini.json"
-    p.write_text(json.dumps(data))
-    feats, answers, dropped = mod.load_squad(str(p), 32, mod.Vocab(64))
-    assert dropped == 0 and len(feats) == 1
-    ids, attn, tt, start, end = feats[0]
-    ctx_words, off, _ = answers[0]
-    # '$400' is context word 2; both span ends point at it
-    assert start - off == 2 and end - off == 2
 
 
 def test_metric_unit_semantics():
@@ -161,3 +153,35 @@ def test_metric_unit_semantics():
     # max_answer_len forbids the wide span; falls back to best short one
     ps, pe = metrics.best_spans(sl, el, max_answer_len=2)
     assert pe[0] - ps[0] < 2
+
+
+# --------------------------------------------------- demoted synthetic smoke
+
+def test_synthetic_marker_smoke():
+    """The old in-band-marker task, kept as a fast smoke test of the QA
+    head's training path only (the real-text harness above is the F1
+    bar): loss must fall on a trivially learnable span corpus."""
+    rng = np.random.default_rng(0)
+    V, T = 128, 32
+
+    def marker_batch():
+        ids = rng.integers(4, V, size=(16, T)).astype(np.int32)
+        start = rng.integers(1, T - 4, size=(16,)).astype(np.int32)
+        end = (start + 2).astype(np.int32)
+        for b in range(16):
+            ids[b, start[b]] = 1
+            ids[b, end[b]] = 2
+        return ids, np.ones_like(ids), np.zeros_like(ids), start, end
+
+    model = BertForQuestionAnswering.from_size(
+        "tiny", vocab_size=V, max_seq_len=T, num_layers=2,
+        hidden_size=64, num_heads=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 16, "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 2e-3}},
+                "bf16": {"enabled": True}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(1)),
+        mesh=make_mesh(model_parallel_size=1))
+    losses = [float(engine.train_batch(marker_batch())) for _ in range(40)]
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5]), losses

@@ -1,0 +1,60 @@
+"""SQuAD featurization unit contracts (deepspeed_tpu.squad).
+
+Fast-tier pins for the host-side data path the model tier builds on:
+window coverage (EVERY context token appears in some window, including
+the stride-misaligned tail), gold-span mapping, and postprocess span→text
+recovery.
+"""
+
+import numpy as np
+
+from deepspeed_tpu import squad
+from deepspeed_tpu.tokenization import BertTokenizer, train_wordpiece
+
+
+def _pipeline(ctx, question, answer, seq_len, doc_stride):
+    exs = [squad.Example(qas_id="q0", question=question, context=ctx,
+                         answers=[answer], answer_start=ctx.index(answer))]
+    vocab = train_wordpiece([ctx, question], vocab_size=96)
+    tok = BertTokenizer(vocab)
+    feats = squad.featurize(exs, tok, seq_len=seq_len,
+                            doc_stride=doc_stride)
+    return exs, tok, feats
+
+
+def test_stride_misaligned_tail_is_covered():
+    """A context whose length minus the window budget is NOT a multiple of
+    doc_stride must still cover its tail tokens (an extra full-width
+    window is emitted) — an answer at the very end stays answerable."""
+    words = " ".join(f"filler{i}" for i in range(40))
+    ctx = words + " the hidden answer sits here"
+    exs, tok, feats = _pipeline(ctx, "where does the answer sit",
+                                "here", seq_len=32, doc_stride=16)
+    n_ctx = len(tok.tokenize(ctx))
+    covered = set()
+    for f in feats:
+        for s in f.token_spans:
+            if s is not None:
+                covered.add(s)
+    # every context token's span appears in some window
+    assert len(covered) == len(set(tok.tokenize_with_offsets(ctx)[1])), (
+        len(covered), n_ctx)
+    assert any(f.has_answer for f in feats), "tail answer lost"
+    # gold span maps back to the answer text through postprocess
+    starts = np.array([f.start_position for f in feats])
+    ends = np.array([f.end_position for f in feats])
+    scores = np.array([1.0 if f.has_answer else -1.0 for f in feats])
+    preds = squad.postprocess(exs, feats, starts, ends, scores)
+    assert preds["q0"] == "here", preds
+
+
+def test_single_window_short_context():
+    ctx = "Paris is the capital of France"
+    exs, _, feats = _pipeline(ctx, "what is the capital",
+                              "Paris", seq_len=48, doc_stride=16)
+    assert len(feats) == 1 and feats[0].has_answer
+    ids, attn, tt, s, e = squad.batch_features(feats)
+    assert ids.shape == (1, 48) and attn.shape == (1, 48)
+    assert s[0] > 0 and e[0] >= s[0]
+    # token_type: question segment 0, context segment 1 where attended
+    assert tt[0][int(s[0])] == 1

@@ -1,0 +1,92 @@
+"""Wordpiece tokenization: trainer, tokenizer, offsets, vocab IO.
+
+Pins the contracts the SQuAD pipeline depends on (squad.py,
+tests/model/test_squad_f1.py): greedy longest-match-first with ``##``
+continuations (BERT semantics), character offsets that index the ORIGINAL
+text, deterministic training, and vocab.txt round-trips.
+"""
+
+import pytest
+
+from deepspeed_tpu.tokenization import (BasicTokenizer, BertTokenizer,
+                                        UNK_TOKEN, Vocab, WordpieceTokenizer,
+                                        SPECIAL_TOKENS, train_wordpiece)
+
+
+def test_basic_tokenizer_offsets_index_original_text():
+    text = "  The cat, named O'Malley — slept. "
+    toks, spans = BasicTokenizer().tokenize_with_offsets(text)
+    # every span slices the surface form whose normalization is the token
+    from deepspeed_tpu.tokenization import normalize_word
+    for tok, (lo, hi) in zip(toks, spans):
+        assert normalize_word(text[lo:hi]) == tok, (tok, text[lo:hi])
+    assert toks[:3] == ["the", "cat", ","]
+    assert "'" in toks            # punctuation split inside O'Malley
+    assert toks[-1] == "."
+
+
+def test_basic_tokenizer_strips_accents():
+    toks, _ = BasicTokenizer().tokenize_with_offsets("Café déjà vu")
+    assert toks == ["cafe", "deja", "vu"]
+
+
+def test_wordpiece_greedy_longest_match():
+    vocab = {t: i for i, t in enumerate(
+        ["un", "##aff", "##able", "##ffa", "##ble", "unaff", "[UNK]"])}
+    wp = WordpieceTokenizer(vocab)
+    # longest first: 'unaff' beats 'un'
+    assert wp.tokenize("unaffable") == ["unaff", "##able"]
+    assert wp.tokenize("zzz") == [UNK_TOKEN]
+    assert wp.tokenize("") == [UNK_TOKEN]
+
+
+def test_trainer_learns_frequent_units_and_is_deterministic():
+    corpus = ["the cat sat on the mat", "the bat and the rat sat"] * 8
+    v1 = train_wordpiece(corpus, vocab_size=64)
+    v2 = train_wordpiece(list(reversed(corpus)), vocab_size=64)
+    assert v1.id_to_token == v2.id_to_token     # order-independent
+    assert list(v1.id_to_token[:5]) == list(SPECIAL_TOKENS)
+    tok = BertTokenizer(v1)
+    # 'the' is the most frequent word: must become a single piece
+    assert tok.tokenize("the") == ["the"]
+    # frequent '##at' family merges
+    assert any(t.endswith("at") for t in v1.id_to_token[5:])
+
+
+def test_full_tokenizer_offsets_roundtrip_substrings():
+    corpus = ["The Amazon River discharges more water than any other "
+              "river on the planet."] * 4
+    vocab = train_wordpiece(corpus, vocab_size=128)
+    tok = BertTokenizer(vocab)
+    text = "The Amazon River discharges water."
+    pieces, spans = tok.tokenize_with_offsets(text)
+    assert len(pieces) == len(spans)
+    # concatenating the span substrings of one word reconstructs it
+    joined = "".join(text[lo:hi] for lo, hi in spans)
+    assert joined.replace(" ", "") == text.replace(" ", "").replace(
+        ".", "") + "."
+    # piece surfaces match their spans (modulo ## and case)
+    for p, (lo, hi) in zip(pieces, spans):
+        if p == UNK_TOKEN:
+            continue
+        assert text[lo:hi].lower() == p.lstrip("#") or \
+            text[lo:hi].lower() == p
+
+
+def test_vocab_save_load_roundtrip(tmp_path):
+    v = train_wordpiece(["hello world hello"], vocab_size=32)
+    p = tmp_path / "vocab.txt"
+    v.save(str(p))
+    v2 = Vocab.load(str(p))
+    assert v2.id_to_token == v.id_to_token
+    assert v2.id("hello") == v.id("hello")
+    assert v2.id("zzzz-not-there") == v2.token_to_id[UNK_TOKEN]
+
+
+def test_encode_uses_unk_for_unknown():
+    v = train_wordpiece(["aaa bbb aaa"], vocab_size=16)
+    tok = BertTokenizer(v)
+    ids = tok.encode("aaa qqq")
+    assert ids[0] != v.token_to_id[UNK_TOKEN]
+    # 'q' never appeared: whole word falls to [UNK]
+    assert v.token_to_id[UNK_TOKEN] in ids
