@@ -18,7 +18,10 @@ Prints ONE json line: {"metric","value","unit","vs_baseline","mfu",...}.
 Env knobs: BENCH_SIZE/BENCH_SEQ/BENCH_BATCH/BENCH_STEPS/BENCH_REMAT/
 BENCH_GAS/BENCH_MAXPRED/BENCH_PALLAS, BENCH_PEAK_TFLOPS (MFU denominator,
 auto-detected from the device kind when unset), BENCH_SWEEP=1 for a
-batch x remat sweep (rows on stderr, best on stdout).
+batch x remat sweep (rows on stderr, best on stdout), BENCH_OUT=<path> to
+also write the JSON line to a file (committed sweep artifacts),
+BENCH_PP_SWEEP=1 with BENCH_PP_SCHEDULES=gpipe,1f1b for the pipeline
+schedule sweep, BENCH_ATTN_SWEEP=1 for the attention-kernel sweep.
 
 Calibration note (v5e, measured): the published 197 bf16 TFLOP/s peak is
 reachable only at large contraction dims (K >= 4096).  BERT-large's body
@@ -35,6 +38,17 @@ import sys
 import time
 
 import numpy as np
+
+
+def _emit(obj):
+    """Print the one-line JSON; also write it to $BENCH_OUT when set (the
+    committed-artifact path, e.g. bench_attn_sweep.json)."""
+    line = json.dumps(obj)
+    print(line)
+    out = os.environ.get("BENCH_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
 
 
 def _count_params(tree):
@@ -204,6 +218,14 @@ def run_pipeline_sweep(steps=4, warmup=2):
     labels = np.roll(toks, -1, axis=1)
     labels[:, -1] = -1
 
+    schedules = [s.strip() for s in
+                 os.environ.get("BENCH_PP_SCHEDULES",
+                                "gpipe,1f1b").split(",") if s.strip()]
+    bad = [s for s in schedules if s not in ("gpipe", "1f1b")]
+    if bad or not schedules:
+        raise RuntimeError(
+            f"BENCH_PP_SCHEDULES entries must be 'gpipe' or '1f1b', "
+            f"got {bad or schedules}")
     rows = []
     pp = 1
     while pp <= n:
@@ -211,31 +233,35 @@ def run_pipeline_sweep(steps=4, warmup=2):
         if per_shard % m or layers % pp:
             pp *= 2
             continue
-        model = GPT2Pipelined.from_size(
-            "tiny", num_micro_batches=m, vocab_size=50257, max_seq_len=seq,
-            num_layers=layers, hidden_size=hidden,
-            num_heads=max(4, hidden // 64))
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            config={"train_batch_size": B, "steps_per_print": 10 ** 9,
-                    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-                    "bf16": {"enabled": True}},
-            model=model,
-            model_parameters=model.init_params(jax.random.PRNGKey(0)),
-            mesh=make_mesh(pipeline_parallel_size=pp))
-        for _ in range(warmup):
-            loss = engine.train_batch((toks, labels))
-        float(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = engine.train_batch((toks, labels))
-        float(loss)
-        dt = time.perf_counter() - t0
-        per_chip = B * steps / dt / n
-        rows.append({"pp": pp, "per_chip": round(per_chip, 2),
-                     "theory_eff": round(m / (m + pp - 1), 3)})
-        print(f"pp={pp}: {per_chip:.2f} samples/s/chip "
-              f"(theory ceiling {m}/{m + pp - 1} = {m / (m + pp - 1):.3f} "
-              f"of pp=1)", file=sys.stderr)
+        for schedule in (("gpipe",) if pp == 1 else schedules):
+            model = GPT2Pipelined.from_size(
+                "tiny", num_micro_batches=m, schedule=schedule,
+                vocab_size=50257, max_seq_len=seq,
+                num_layers=layers, hidden_size=hidden,
+                num_heads=max(4, hidden // 64))
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                config={"train_batch_size": B, "steps_per_print": 10 ** 9,
+                        "optimizer": {"type": "Adam",
+                                      "params": {"lr": 1e-4}},
+                        "bf16": {"enabled": True}},
+                model=model,
+                model_parameters=model.init_params(jax.random.PRNGKey(0)),
+                mesh=make_mesh(pipeline_parallel_size=pp))
+            for _ in range(warmup):
+                loss = engine.train_batch((toks, labels))
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch((toks, labels))
+            float(loss)
+            dt = time.perf_counter() - t0
+            per_chip = B * steps / dt / n
+            rows.append({"pp": pp, "schedule": schedule,
+                         "per_chip": round(per_chip, 2),
+                         "theory_eff": round(m / (m + pp - 1), 3)})
+            print(f"pp={pp} {schedule}: {per_chip:.2f} samples/s/chip "
+                  f"(theory ceiling {m}/{m + pp - 1} = "
+                  f"{m / (m + pp - 1):.3f} of pp=1)", file=sys.stderr)
         pp *= 2
 
     base = rows[0]["per_chip"]
@@ -248,7 +274,7 @@ def run_pipeline_sweep(steps=4, warmup=2):
         # virtual CPU devices share one host: per-chip numbers measure the
         # schedule's program structure, not ICI/bubble costs
         out["note"] = "virtual CPU mesh; per-chip figures not hardware-true"
-    print(json.dumps(out))
+    _emit(out)
     return 0
 
 
@@ -301,9 +327,9 @@ def run_attention_sweep(steps=10, warmup=3):
         print(f"attn={rows[-1]['attn']}: {rows[-1]['ms_per_step']} ms/step",
               file=sys.stderr)
     speedup = rows[0]["ms_per_step"] / rows[1]["ms_per_step"]
-    print(json.dumps({"metric": f"gpt2_seq{T}_attention_kernel_speedup",
-                      "value": round(speedup, 3), "unit": "x vs XLA path",
-                      "rows": rows}))
+    _emit({"metric": f"gpt2_seq{T}_attention_kernel_speedup",
+           "value": round(speedup, 3), "unit": "x vs XLA path",
+           "rows": rows})
     return 0
 
 
@@ -350,7 +376,7 @@ def main():
     else:
         res = run_config(size, seq, batch_per_chip, steps, remat, gas=gas)
 
-    print(json.dumps({
+    _emit({
         "metric": "bert_%s_seq%d_pretrain_samples_per_sec_per_chip"
                   % (size, seq),
         "value": round(res["per_chip"], 2),
@@ -361,7 +387,7 @@ def main():
         "batch_per_chip": batch_per_chip,
         "gas": gas,
         "remat": remat,
-    }))
+    })
     return 0
 
 
