@@ -11,7 +11,7 @@ from deepspeed_tpu.models.transformer import (TransformerConfig,
                                               token_batch_specs)
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
 from deepspeed_tpu.models.pipeline_gpt2 import GPT2Pipelined
-from deepspeed_tpu.models.gpt2_moe import GPT2MoE
+from deepspeed_tpu.models.gpt2_moe import GPT2MoE, GPT2MoEPipelined
 from deepspeed_tpu.models.moe import MoEConfig
 from deepspeed_tpu.models.bert import (BertForPreTraining,
                                        BertForQuestionAnswering, BERT_SIZES)
@@ -20,6 +20,6 @@ __all__ = [
     "TransformerConfig", "init_block_params", "block_partition_specs",
     "block_apply", "stack_apply", "token_batch_specs",
     "GPT2", "GPT2_SIZES",
-    "GPT2Pipelined", "GPT2MoE", "MoEConfig",
+    "GPT2Pipelined", "GPT2MoE", "GPT2MoEPipelined", "MoEConfig",
     "BertForPreTraining", "BertForQuestionAnswering", "BERT_SIZES",
 ]

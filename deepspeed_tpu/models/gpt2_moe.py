@@ -14,6 +14,7 @@ import dataclasses
 
 from deepspeed_tpu.models import moe as M
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2_SIZES
+from deepspeed_tpu.models.pipeline_gpt2 import GPT2Pipelined
 
 
 @dataclasses.dataclass
@@ -42,4 +43,48 @@ class GPT2MoE(GPT2):
 
     def _stack(self, x, blocks):
         x, aux = M.moe_stack_apply(x, blocks, self.config)
+        return x, self.config.aux_weight * aux
+
+
+@dataclasses.dataclass
+class GPT2MoEPipelined(GPT2Pipelined):
+    """MoE x pipeline parallelism: expert-stacked blocks shard their layer
+    dim over ``pipe`` AND their expert dim over ``model`` (expert
+    parallelism), micro-batches stream through the GPipe schedule, and
+    each stage's Switch aux loss (masked to its real micro-batch ticks)
+    psums over the pipe ring into the LM loss.
+
+    Composes with ZeRO (per-(stage, expert-shard) [S, local] flat
+    masters), DP, and checkpointing like any pipe x model sharded model.
+    The 1F1B schedule does not carry the aux channel yet — selecting it
+    raises.
+    """
+    config: M.MoEConfig = None
+
+    @classmethod
+    def from_size(cls, size: str, num_experts: int = 8,
+                  capacity_factor: float = 1.25, aux_weight: float = 0.01,
+                  router_top_k: int = 1, num_micro_batches: int = 2,
+                  schedule: str = "gpipe",
+                  **overrides) -> "GPT2MoEPipelined":
+        base = GPT2MoE.from_size(size, num_experts=num_experts,
+                                 capacity_factor=capacity_factor,
+                                 aux_weight=aux_weight,
+                                 router_top_k=router_top_k, **overrides)
+        return cls(config=base.config,
+                   num_micro_batches=num_micro_batches, schedule=schedule)
+
+    _init_blocks = GPT2MoE._init_blocks
+    _block_specs = GPT2MoE._block_specs
+
+    def apply(self, params, tokens, labels):
+        if self.schedule == "1f1b":
+            raise NotImplementedError(
+                "MoE x pipeline runs the GPipe schedule: the 1F1B path "
+                "does not carry the per-stage aux-loss channel (set "
+                "pipeline_schedule='gpipe' or drop the override)")
+        return super().apply(params, tokens, labels)
+
+    def _pipe_stack(self, u, blocks):
+        x, aux = M.moe_stack_apply(u, blocks, self.config)
         return x, self.config.aux_weight * aux

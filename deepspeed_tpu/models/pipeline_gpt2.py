@@ -9,9 +9,9 @@ stage and psum'd, so stage-replicated parameter gradients arrive as
 per-stage partial sums the engine completes over ``pipe``.
 
 Composes with tensor parallelism (blocks sharded over BOTH pipe and model),
-data parallelism, ZeRO-1 (per-stage [S, local] flat masters), and
-checkpointing (per-stage model files); context parallelism with pp>1 stays
-engine-guarded.
+data parallelism, context parallelism (ring attention inside the stage
+body), ZeRO-1 (per-stage [S, local] flat masters), and checkpointing
+(per-stage model files).
 """
 
 from __future__ import annotations
@@ -99,11 +99,15 @@ class GPT2Pipelined(GPT2):
 
         def stage_fn(u):
             # inside shard_map the blocks leaf is this stage's LOCAL
-            # [L/pp, ...] slice; stack_apply scans exactly those layers
+            # [L/pp, ...] slice; the stack hook scans exactly those layers
             # (with the configured remat policy)
-            return T.stack_apply(u, params["blocks"], cfg)
+            return self._pipe_stack(u, params["blocks"])
 
-        x = pipe_mod.pipeline_apply(x_micro, stage_fn)
+        x, aux = pipe_mod.pipeline_apply(x_micro, stage_fn, with_aux=True)
+        # per-micro aux terms are means over their own tokens: average over
+        # micros so aux_weight's meaning is independent of m (the LM loss
+        # is likewise a mean over all tokens)
+        aux = aux / m
         x = x.reshape(B, T_len, x.shape[-1])
 
         # head sharded over the pipe stages: each computes LN + vocab
@@ -119,6 +123,11 @@ class GPT2Pipelined(GPT2):
             mask = (ys >= 0).astype(jnp.float32)
             return jnp.sum(ce * mask), jnp.sum(mask)
 
-        return pipe_mod.pipe_sharded_loss(x, labels, head_fn)
+        return pipe_mod.pipe_sharded_loss(x, labels, head_fn) + aux
+
+    def _pipe_stack(self, u, blocks):
+        """Stage-stack hook: returns (y, aux scalar).  The MoE variant
+        overrides this with the expert stack + load-balance aux."""
+        return T.stack_apply(u, blocks, self.config), 0.0
 
     __call__ = apply

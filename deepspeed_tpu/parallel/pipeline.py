@@ -32,16 +32,20 @@ from deepspeed_tpu.parallel.topology import PIPE_AXIS
 
 def pipeline_apply(x_micro: jnp.ndarray,
                    stage_fn: Callable[[jnp.ndarray], jnp.ndarray],
-                   axis: str = PIPE_AXIS) -> jnp.ndarray:
+                   axis: str = PIPE_AXIS, with_aux: bool = False):
     """Run the GPipe schedule.
 
     x_micro:  [m, mb, ...] micro-batched activations, replicated over
               ``axis`` (every stage holds them; only stage 0 injects).
-    stage_fn: applies THIS stage's local blocks to one [mb, ...] activation.
+    stage_fn: applies THIS stage's local blocks to one [mb, ...]
+              activation.  With ``with_aux`` it returns ``(y, aux)`` where
+              aux is a scalar per-stage loss term (e.g. MoE load
+              balancing); aux from bubble ticks (garbage activations) is
+              masked out, and per-stage totals psum over ``axis``.
 
     Returns [m, mb, ...] outputs, replicated over ``axis`` (psum-collected
-    from the last stage).  Must run inside shard_map over a mesh with
-    ``axis``.
+    from the last stage) — plus the pipe-uniform aux sum when
+    ``with_aux``.  Must run inside shard_map over a mesh with ``axis``.
     """
     pp = jax.lax.axis_size(axis)
     stage = jax.lax.axis_index(axis)
@@ -51,13 +55,21 @@ def pipeline_apply(x_micro: jnp.ndarray,
     is_last = (stage == pp - 1)
 
     def tick(carry, t):
-        buf, outputs = carry
+        buf, outputs, aux_acc = carry
         # stage 0 ingests micro-batch t (clipped re-injections past the end
         # never reach the last stage within the scan — wasted, not wrong)
         inject = jax.lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
         cur = jnp.where(is_first, inject, buf)
-        y = stage_fn(cur)
+        if with_aux:
+            y, aux = stage_fn(cur)
+            # this stage's tick t computes micro f = t - stage; other
+            # ticks are bubbles whose aux is garbage
+            f = t - stage
+            aux_acc = aux_acc + jnp.where(
+                (f >= 0) & (f < m), jnp.asarray(aux, jnp.float32), 0.0)
+        else:
+            y = stage_fn(cur)
         # the last stage's y at tick t is finished micro t - (pp - 1)
         out_t = t - (pp - 1)
         updated = jax.lax.dynamic_update_index_in_dim(
@@ -67,15 +79,21 @@ def pipeline_apply(x_micro: jnp.ndarray,
         # hand off to the next stage (the wrap edge pp-1 -> 0 carries only
         # garbage that stage 0 immediately overwrites with its injection)
         buf = jax.lax.ppermute(y, axis, perm)
-        return (buf, outputs), None
+        return (buf, outputs, aux_acc), None
 
     buf0 = jnp.zeros_like(x_micro[0])
     out0 = jnp.zeros_like(x_micro)
-    (_, outputs), _ = jax.lax.scan(tick, (buf0, out0),
-                                   jnp.arange(m + pp - 1))
+    (_, outputs, aux_acc), _ = jax.lax.scan(
+        tick, (buf0, out0, jnp.zeros((), jnp.float32)),
+        jnp.arange(m + pp - 1))
     # only the last stage holds real outputs; make them uniform
     outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
-    return jax.lax.psum(outputs, axis)
+    outputs = jax.lax.psum(outputs, axis)
+    if with_aux:
+        # stages own disjoint layers: the global aux is the psum of the
+        # per-stage micro-masked totals (pipe-uniform, like the loss)
+        return outputs, jax.lax.psum(aux_acc, axis)
+    return outputs
 
 
 def pipeline_1f1b_loss(stage_fn, head_fn, blocks, head_params, x_micro,

@@ -83,7 +83,12 @@ def main():
 
     VOCAB, SEQ = args.vocab, args.seq
     kw = dict(vocab_size=VOCAB, max_seq_len=SEQ)
-    if args.moe_experts > 0:
+    if args.moe_experts > 0 and args.micro_batches > 0:
+        from deepspeed_tpu.models import GPT2MoEPipelined
+        model = GPT2MoEPipelined.from_size(
+            args.size, num_experts=args.moe_experts,
+            num_micro_batches=args.micro_batches, **kw)
+    elif args.moe_experts > 0:
         model = GPT2MoE.from_size(args.size, num_experts=args.moe_experts,
                                   **kw)
     elif args.micro_batches > 0:
