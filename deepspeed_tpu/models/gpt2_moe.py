@@ -55,9 +55,8 @@ class GPT2MoEPipelined(GPT2Pipelined):
     psums over the pipe ring into the LM loss.
 
     Composes with ZeRO (per-(stage, expert-shard) [S, local] flat
-    masters), DP, and checkpointing like any pipe x model sharded model.
-    The 1F1B schedule does not carry the aux channel yet — selecting it
-    raises.
+    masters), DP, checkpointing, and both pipeline schedules (the 1F1B
+    path carries the aux channel through its custom_vjp).
     """
     config: M.MoEConfig = None
 
@@ -76,14 +75,6 @@ class GPT2MoEPipelined(GPT2Pipelined):
 
     _init_blocks = GPT2MoE._init_blocks
     _block_specs = GPT2MoE._block_specs
-
-    def apply(self, params, tokens, labels):
-        if self.schedule == "1f1b":
-            raise NotImplementedError(
-                "MoE x pipeline runs the GPipe schedule: the 1F1B path "
-                "does not carry the per-stage aux-loss channel (set "
-                "pipeline_schedule='gpipe' or drop the override)")
-        return super().apply(params, tokens, labels)
 
     def _pipe_stack(self, u, blocks):
         x, aux = M.moe_stack_apply(u, blocks, self.config)

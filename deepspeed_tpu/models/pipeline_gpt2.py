@@ -84,7 +84,7 @@ class GPT2Pipelined(GPT2):
                            "wte": params["wte"]}
 
             def stage_1f1b(blocks, u):
-                return T.stack_apply(u, blocks, cfg)
+                return self._pipe_stack(u, blocks)   # (y, aux)
 
             def head_1f1b(hp, y, ys):
                 h = L.layer_norm(y, hp["lnf_s"], hp["lnf_b"], cfg.ln_eps)
@@ -95,7 +95,7 @@ class GPT2Pipelined(GPT2):
 
             return pipe_mod.pipeline_1f1b_loss(
                 stage_1f1b, head_1f1b, params["blocks"], head_params,
-                x_micro, labels_micro, count)
+                x_micro, labels_micro, count, with_aux=True)
 
         def stage_fn(u):
             # inside shard_map the blocks leaf is this stage's LOCAL

@@ -97,7 +97,8 @@ def pipeline_apply(x_micro: jnp.ndarray,
 
 
 def pipeline_1f1b_loss(stage_fn, head_fn, blocks, head_params, x_micro,
-                       labels_micro, count_total, axis: str = PIPE_AXIS):
+                       labels_micro, count_total, axis: str = PIPE_AXIS,
+                       with_aux: bool = False):
     """Pipeline forward+loss with a 1F1B (one-forward-one-backward)
     gradient schedule.
 
@@ -114,7 +115,11 @@ def pipeline_1f1b_loss(stage_fn, head_fn, blocks, head_params, x_micro,
     saves — the 1F1B memory win at large micro-batch counts.
 
     Args:
-      stage_fn: ``(blocks_local, x[mb, ...]) -> y`` — this stage's blocks.
+      stage_fn: ``(blocks_local, x[mb, ...]) -> y`` — this stage's blocks
+                (``(y, aux_scalar)`` when ``with_aux``: the per-stage aux
+                terms — e.g. MoE load balancing — are averaged over
+                micro-batches, psum'd over stages, and added to the
+                loss, matching the GPipe path's convention).
       head_fn:  ``(head_params, y, labels[mb, ...]) -> loss SUM`` (masked
                 sum, fp32 scalar; labels arrive with their original
                 integer dtype) — runs per micro on the last stage.
@@ -139,13 +144,17 @@ def pipeline_1f1b_loss(stage_fn, head_fn, blocks, head_params, x_micro,
     lab_shape = tuple(labf.shape)
     hfn = lambda hp, y, lf: head_fn(hp, y, lf.astype(lab_dtype))
 
+    # normalize to the (y, aux) stage signature internally
+    sfn = (stage_fn if with_aux
+           else (lambda bl, u: (stage_fn(bl, u), 0.0)))
+
     @jax.custom_vjp
     def run(blocks, head_params, x_micro, labf, count_total):
-        return _forward_1f1b(stage_fn, hfn, axis, blocks, head_params,
+        return _forward_1f1b(sfn, hfn, axis, blocks, head_params,
                              x_micro, labf, count_total)
 
     def fwd(blocks, head_params, x_micro, labf, count_total):
-        return _run_1f1b(stage_fn, hfn, axis, blocks, head_params,
+        return _run_1f1b(sfn, hfn, axis, blocks, head_params,
                          x_micro, labf, count_total)
 
     def bwd(res, g):
@@ -174,7 +183,10 @@ def _forward_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
         inject = jax.lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
         cur = jnp.where(stage == 0, inject, buf)
-        y = stage_fn(blocks, cur)
+        y, aux = stage_fn(blocks, cur)
+        f = t - stage
+        aux = jnp.where((f >= 0) & (f < m),
+                        jnp.asarray(aux, jnp.float32), 0.0)
         out_t = t - (pp - 1)
         lab = jax.lax.dynamic_index_in_dim(
             labf, jnp.clip(out_t, 0, m - 1), axis=0, keepdims=False)
@@ -182,12 +194,13 @@ def _forward_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
         lsum = jnp.where(is_last & (out_t >= 0),
                          jnp.asarray(lsum, jnp.float32), 0.0)
         return jax.lax.ppermute(y, axis, [(i, (i + 1) % pp)
-                                          for i in range(pp)]), lsum
+                                          for i in range(pp)]), (lsum, aux)
 
-    _, lsums = jax.lax.scan(tick, jnp.zeros_like(x_micro[0]),
-                            jnp.arange(m + pp - 1))
+    _, (lsums, auxes) = jax.lax.scan(tick, jnp.zeros_like(x_micro[0]),
+                                     jnp.arange(m + pp - 1))
     loss_sum = jax.lax.psum(jnp.sum(lsums), axis)
-    return loss_sum / jnp.maximum(count_total, 1.0)
+    aux_mean = jax.lax.psum(jnp.sum(auxes), axis) / m
+    return loss_sum / jnp.maximum(count_total, 1.0) + aux_mean
 
 
 def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
@@ -206,7 +219,8 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
     seed = 1.0 / count                   # d(loss)/d(per-micro loss sum)
 
     def tick(carry, t):
-        fwd_buf, bwd_buf, ring, dx_out, gblocks, ghead, loss_sum = carry
+        (fwd_buf, bwd_buf, ring, dx_out, gblocks, ghead, loss_sum,
+         aux_sum) = carry
 
         # ---- forward sub-step: micro f enters this stage
         f = t - stage
@@ -219,7 +233,7 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
             jax.lax.dynamic_update_index_in_dim(
                 ring, fin, jnp.mod(f, R), axis=0),
             ring)
-        fwd_send = stage_fn(blocks, fin)
+        fwd_send, _ = stage_fn(blocks, fin)
 
         # ---- backward sub-step: micro b leaves this stage (recompute
         # from the saved input; on the last stage b == f, so the head's
@@ -228,7 +242,7 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
         active_b = (b >= 0) & (b < m)
         xb = jax.lax.dynamic_index_in_dim(
             ring, jnp.mod(b, R), axis=0, keepdims=False)
-        yb, pull = jax.vjp(stage_fn, blocks, xb)
+        (yb, aux_b), pull = jax.vjp(stage_fn, blocks, xb)
         lab = jax.lax.dynamic_index_in_dim(
             labf, jnp.clip(b, 0, m - 1), axis=0, keepdims=False)
         lsum, hpull = jax.vjp(
@@ -236,7 +250,10 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
             head_params, yb)
         dhead_b, dy_head = hpull(jnp.asarray(seed, jnp.float32))
         dy = jnp.where(is_last, dy_head.astype(yb.dtype), bwd_buf)
-        dblocks_b, dxin = pull(dy)
+        # aux averages over micros: d(loss)/d(aux_b) = 1/m (bubble ticks
+        # are zeroed by the acc_b accumulation mask below)
+        daux = jnp.asarray(1.0 / m, jnp.result_type(aux_b))
+        dblocks_b, dxin = pull((dy, daux))
 
         acc_b = jnp.where(active_b, 1.0, 0.0)
         gblocks = jax.tree_util.tree_map(
@@ -251,11 +268,13 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
             dx_out)
         loss_sum = loss_sum + jnp.where(active_b & is_last,
                                         lsum.astype(jnp.float32), 0.0)
+        aux_sum = aux_sum + jnp.where(
+            active_b, jnp.asarray(aux_b, jnp.float32), 0.0)
 
         fwd_buf = jax.lax.ppermute(fwd_send, axis, fwd_perm)
         bwd_buf = jax.lax.ppermute(dxin, axis, bwd_perm)
         return (fwd_buf, bwd_buf, ring, dx_out, gblocks, ghead,
-                loss_sum), None
+                loss_sum, aux_sum), None
 
     zeros_like_tree = lambda tree: jax.tree_util.tree_map(
         lambda x: jnp.zeros(x.shape, x.dtype), tree)
@@ -267,10 +286,12 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
         zeros_like_tree(blocks),
         zeros_like_tree(head_params),
         jnp.zeros((), jnp.float32),
+        jnp.zeros((), jnp.float32),
     )
-    (_, _, _, dx_out, gblocks, ghead, loss_sum), _ = jax.lax.scan(
+    (_, _, _, dx_out, gblocks, ghead, loss_sum, aux_sum), _ = jax.lax.scan(
         tick, carry0, jnp.arange(m + 2 * (pp - 1)))
-    loss = jax.lax.psum(loss_sum, axis) / count
+    loss = (jax.lax.psum(loss_sum, axis) / count
+            + jax.lax.psum(aux_sum, axis) / m)
     return loss, (gblocks, ghead, dx_out)
 
 

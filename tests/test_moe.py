@@ -346,20 +346,30 @@ def test_moe_pipeline_matches_single_stage():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
-def test_moe_pipeline_rejects_1f1b():
+def test_moe_pipeline_1f1b_matches_gpipe():
+    """MoE x 1F1B: the interleaved schedule carries the aux channel
+    through its custom_vjp — trajectory equals the GPipe schedule on the
+    same model/data (the schedule must not change the math)."""
     from deepspeed_tpu.models import GPT2MoEPipelined
-    model = GPT2MoEPipelined.from_size(
-        "tiny", num_experts=4, vocab_size=VOCAB, max_seq_len=SEQ,
-        num_layers=4, hidden_size=32, num_heads=4)
-    model.schedule = "1f1b"
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        config={"train_batch_size": 8, "steps_per_print": 10 ** 6,
-                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
-        model=model,
-        model_parameters=model.init_params(jax.random.PRNGKey(7)),
-        mesh=make_mesh(pipeline_parallel_size=2))
-    with pytest.raises(NotImplementedError, match="aux"):
-        engine.train_batch(chain_batch(8))
+
+    def run(schedule):
+        model = GPT2MoEPipelined.from_size(
+            "tiny", num_experts=4, schedule=schedule, vocab_size=VOCAB,
+            max_seq_len=SEQ, num_layers=4, hidden_size=32, num_heads=4,
+            num_micro_batches=2, capacity_factor=2.0)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config={"train_batch_size": 8, "steps_per_print": 10 ** 6,
+                    "optimizer": {"type": "SGD", "params": {"lr": 0.3}}},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(7)),
+            mesh=make_mesh(pipeline_parallel_size=2,
+                           model_parallel_size=2))
+        return [float(engine.train_batch(chain_batch(8, seed=i)))
+                for i in range(3)]
+
+    # SGD pins the absolute gradient scale, aux grads included
+    np.testing.assert_allclose(run("1f1b"), run("gpipe"),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_experts_not_divisible_by_ep_rejected():
