@@ -76,6 +76,4 @@ class GPT2MoEPipelined(GPT2Pipelined):
     _init_blocks = GPT2MoE._init_blocks
     _block_specs = GPT2MoE._block_specs
 
-    def _pipe_stack(self, u, blocks):
-        x, aux = M.moe_stack_apply(u, blocks, self.config)
-        return x, self.config.aux_weight * aux
+    _pipe_stack = GPT2MoE._stack

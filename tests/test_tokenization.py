@@ -83,6 +83,24 @@ def test_vocab_save_load_roundtrip(tmp_path):
     assert v2.id("zzzz-not-there") == v2.token_to_id[UNK_TOKEN]
 
 
+def test_wordpiece_memo_cache_is_transparent():
+    """The word→pieces memo must never change results — cached and
+    uncached calls agree on every input class (match, UNK, unicode,
+    over-length), and results are fresh lists (caller mutation safe)."""
+    corpus = ["the cat sat on the mat", "unaffable runners ran",
+              "café déjà vu naïve", "растение растёт"] * 4
+    vocab = train_wordpiece(corpus, vocab_size=160)
+    cached = WordpieceTokenizer(vocab.token_to_id)
+    cold = WordpieceTokenizer(vocab.token_to_id, cache_size=0)
+    words = ([w for t in corpus for w in t.split()]
+             + ["zzz", "q", "", "a" * 101, "caférastение"]) * 2
+    for w in words:           # second sweep hits the memo
+        a, b = cached.tokenize(w), cold.tokenize(w)
+        assert a == b, (w, a, b)
+        a.append("mutated")   # must not poison the cache
+        assert cached.tokenize(w) == b, w
+
+
 def test_encode_uses_unk_for_unknown():
     v = train_wordpiece(["aaa bbb aaa"], vocab_size=16)
     tok = BertTokenizer(v)
