@@ -301,7 +301,10 @@ def run_attention_sweep(steps=10, warmup=3):
     labels[:, -1] = -1
 
     rows = []
-    for mode in ("0", "auto"):
+    # "0" = XLA einsum path, "1" = streaming kernel FORCED (the auto
+    # dispatch would silently fall back to XLA below STREAM_AUTO_MIN and
+    # the "speedup" would compare XLA with itself)
+    for mode in ("0", "1"):
         os.environ["DSTPU_FUSED_ATTN"] = mode
         model = GPT2.from_size("tiny", vocab_size=50304, max_seq_len=T,
                                num_layers=12, hidden_size=768, num_heads=12)
@@ -324,6 +327,7 @@ def run_attention_sweep(steps=10, warmup=3):
         rows.append({"attn": "xla" if mode == "0" else "stream-pallas",
                      "ms_per_step": round(dt * 1000, 1),
                      "samples_per_sec": round(B / dt, 2)})
+        os.environ.pop("DSTPU_FUSED_ATTN", None)
         print(f"attn={rows[-1]['attn']}: {rows[-1]['ms_per_step']} ms/step",
               file=sys.stderr)
     speedup = rows[0]["ms_per_step"] / rows[1]["ms_per_step"]
