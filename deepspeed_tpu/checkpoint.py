@@ -417,25 +417,49 @@ def _save_zero_checkpoint(engine, save_dir: str, tag: str) -> None:
 
 # ------------------------------------------------------------------ loading
 
-def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
-                    load_optimizer_states: bool = True,
-                    load_lr_scheduler_states: bool = True):
-    """Engine-level load (reference load_checkpoint :974-1046).  Returns
-    ``(path, client_state)``; (None, None) when nothing is found."""
+def load_module_tree(load_dir: str, tag: Optional[str] = None, specs=None):
+    """Host-side module pytree reassembled from a checkpoint's model-state
+    files, WITHOUT an engine — the raw-weights read behind
+    pretrain→fine-tune transfer (reference BingBertSquad initializes from
+    a pretrained BERT checkpoint this way).
+
+    ``specs`` (a PartitionSpec tree matching the SAVED module structure)
+    is required only when the checkpoint was written at mp>1 or pp>1 —
+    reassembly must know which dims concatenate.  Returns None when no
+    checkpoint exists under ``load_dir``.
+    """
+    read = _read_model_states(load_dir, tag)
+    if read is None:
+        return None
+    _, states, saved_mp, saved_pp = read
+    if saved_mp * saved_pp == 1:
+        return states[0]["module"]
+    if specs is None:
+        raise ValueError(
+            f"checkpoint was saved at mp={saved_mp}, pp={saved_pp}: pass "
+            "specs (the saving model's partition_specs) so sharded leaves "
+            "can be reassembled")
+    return _combine_shard_states([s["module"] for s in states], specs,
+                                 _state_axes(saved_pp, saved_mp))
+
+
+def _read_model_states(load_dir: str, tag: Optional[str]):
+    """Shared tag-resolution + model-state file reads (load_checkpoint and
+    load_module_tree).  Returns ``(tag, states, saved_mp, saved_pp)`` or
+    None when no checkpoint exists."""
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest):
-            return None, None
+            return None
         with open(latest) as f:
             tag = f.read().strip()
-
     mfile = model_file(load_dir, tag, 0)
     if not os.path.exists(mfile):
         # pp>1 saves use per-stage file names; the template does not embed
         # the pp degree, so stage 0 / mp rank 0 is the canonical probe
         mfile = os.path.join(load_dir, tag, MODEL_FILE_PP.format(pp=0, mp=0))
         if not os.path.exists(mfile):
-            return None, None
+            return None
     state = _load_obj(mfile)
     saved_mp = int(state.get("mp_world_size", 1))
     saved_pp = int(state.get("pp_world_size", 1))
@@ -443,6 +467,44 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         _load_obj(model_file(load_dir, tag, r % saved_mp, r // saved_mp,
                              saved_pp))
         for r in range(1, saved_pp * saved_mp)]
+    return tag, states, saved_mp, saved_pp
+
+
+def init_from_module_tree(engine, module) -> tuple:
+    """Transfer same-named, same-shaped leaves of ``module`` into
+    ``engine.params`` — the pretrain→fine-tune initialization (a fresh
+    task head keeps its random init).  fp32 masters re-derive from the
+    merged params so the first ``step()`` cannot revert the transfer.
+    Returns ``(loaded, skipped)`` key-path lists.
+    """
+    src = {jax.tree_util.keystr(k): v
+           for k, v in jax.tree_util.tree_leaves_with_path(module)}
+    loaded, skipped = [], []
+
+    def merge(path, old):
+        key = jax.tree_util.keystr(path)
+        new = src.get(key)
+        if new is not None and tuple(np.shape(new)) == tuple(old.shape):
+            loaded.append(key)
+            return jax.device_put(jnp.asarray(new, old.dtype), old.sharding)
+        skipped.append(key)
+        return old
+
+    engine.params = jax.tree_util.tree_map_with_path(merge, engine.params)
+    _rederive_masters(engine)
+    return loaded, skipped
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_lr_scheduler_states: bool = True):
+    """Engine-level load (reference load_checkpoint :974-1046).  Returns
+    ``(path, client_state)``; (None, None) when nothing is found."""
+    read = _read_model_states(load_dir, tag)
+    if read is None:
+        return None, None
+    tag, states, saved_mp, saved_pp = read
+    state = states[0]
 
     # module weights (compute dtype), reassembled from the per-stage/MP-rank
     # local slices and re-sharded for the CURRENT mesh — reference :995-1004

@@ -7,6 +7,15 @@ reference kernel's trust-ratio semantics, fp16 dynamic loss scaling.
 
     python examples/bert/pretrain_bert.py \
         --deepspeed_config examples/bert/ds_config_lamb.json --steps 100
+
+Real-text pretraining + the fine-tune hand-off (the full BingBert
+workflow, pretrain → SQuAD):
+
+    python examples/bert/pretrain_bert.py --corpus my_text.txt \
+        --save-vocab vocab.txt --save-checkpoint ckpts \
+        --deepspeed_config examples/bert/ds_config_lamb.json
+    python examples/bert/squad_finetune.py --train-file squad.json \
+        --vocab-file vocab.txt --init-checkpoint ckpts ...
 """
 
 import os as _os
@@ -31,29 +40,96 @@ VOCAB, SEQ = 512, 64
 MASK_FRAC = 0.15
 
 
-def mlm_batch(rng, batch):
+def mlm_batch(rng, batch, vocab=None, seq=None):
     """ids/mask/token-type + dense MLM labels (-1 = not predicted)."""
-    ids = rng.integers(4, VOCAB, size=(batch, SEQ)).astype(np.int32)
-    # structure: second half echoes the first (so MLM is learnable)
-    ids[:, SEQ // 2:] = (ids[:, :SEQ // 2] * 7 + 3) % (VOCAB - 4) + 4
-    attn = np.ones((batch, SEQ), np.int32)
-    tt = np.zeros((batch, SEQ), np.int32)
-    tt[:, SEQ // 2:] = 1
-    labels = np.full((batch, SEQ), -1, np.int32)
-    pick = rng.random((batch, SEQ)) < MASK_FRAC
+    V, T = vocab or VOCAB, seq or SEQ
+    ids = rng.integers(4, V, size=(batch, T)).astype(np.int32)
+    # structure: second half echoes the first (so MLM is learnable);
+    # slice widths match for odd T too
+    half = T // 2
+    ids[:, half:] = (ids[:, :T - half] * 7 + 3) % (V - 4) + 4
+    attn = np.ones((batch, T), np.int32)
+    tt = np.zeros((batch, T), np.int32)
+    tt[:, T // 2:] = 1
+    labels = np.full((batch, T), -1, np.int32)
+    pick = rng.random((batch, T)) < MASK_FRAC
     labels[pick] = ids[pick]
     ids = np.where(pick, 3, ids)          # 3 = [MASK]
     return ids, attn, tt, labels
 
 
+def corpus_batcher(path, vocab_size, seq, vocab_file=None,
+                   save_vocab=None):
+    """Real-text MLM pipeline: wordpiece vocab (trained in-process or
+    loaded), the corpus encoded once into one id stream, batches drawn as
+    random seq-length windows with 15% masking."""
+    from deepspeed_tpu.tokenization import (BertTokenizer, MASK_TOKEN,
+                                            Vocab, train_wordpiece)
+    with open(path) as f:
+        lines = [l.strip() for l in f if l.strip()]
+    if vocab_file:
+        vocab = Vocab.load(vocab_file)
+    else:
+        print(f"training a {vocab_size}-piece vocabulary from "
+              f"{len(lines)} lines ...")
+        vocab = train_wordpiece(lines, vocab_size=vocab_size)
+    if save_vocab:
+        vocab.save(save_vocab)
+    tok = BertTokenizer(vocab)
+    stream = np.asarray([i for line in lines for i in tok.encode(line)],
+                        np.int32)
+    if stream.size < seq + 1:
+        raise RuntimeError(
+            f"corpus {path} tokenizes to only {stream.size} pieces; need "
+            f"> --seq-len {seq}")
+    mask_id = vocab.id(MASK_TOKEN)
+    print(f"corpus: {stream.size} wordpieces, vocab {len(vocab)}")
+
+    def batcher(rng, batch):
+        lo = rng.integers(0, stream.size - seq, size=batch)
+        ids = np.stack([stream[l:l + seq] for l in lo])
+        attn = np.ones((batch, seq), np.int32)
+        tt = np.zeros((batch, seq), np.int32)
+        labels = np.full((batch, seq), -1, np.int32)
+        pick = rng.random((batch, seq)) < MASK_FRAC
+        labels[pick] = ids[pick]
+        ids = np.where(pick, mask_id, ids).astype(np.int32)
+        return ids, attn, tt, labels
+
+    return batcher, len(vocab)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--seq-len", type=int, default=SEQ)
+    parser.add_argument("--corpus",
+                        help="plain-text file: real-text MLM pretraining "
+                             "(wordpiece vocab trained in-process)")
+    parser.add_argument("--vocab-size", type=int, default=8192)
+    parser.add_argument("--vocab-file",
+                        help="load a saved vocab.txt instead of training")
+    parser.add_argument("--save-vocab",
+                        help="write the trained vocabulary here")
+    parser.add_argument("--save-checkpoint",
+                        help="save an engine checkpoint here at the end "
+                             "(fine-tune with squad_finetune.py "
+                             "--init-checkpoint)")
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
+    seq = args.seq_len
+    if args.corpus:
+        batcher, vocab_size = corpus_batcher(
+            args.corpus, args.vocab_size, seq,
+            vocab_file=args.vocab_file, save_vocab=args.save_vocab)
+        vocab_size += (-vocab_size) % 8   # TP divisibility (vocab % 8)
+    else:
+        vocab_size = VOCAB
+        batcher = lambda rng, b: mlm_batch(rng, b, vocab_size, seq)
+
     model = BertForPreTraining.from_size(
-        "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+        "tiny", vocab_size=vocab_size, max_seq_len=seq,
         num_layers=4, hidden_size=128, num_heads=4)
     engine, optimizer, _, _ = deepspeed_tpu.initialize(
         args, model=model,
@@ -65,7 +141,7 @@ def main():
     while step < args.steps:
         # split API: gas micro-batches per optimizer step
         for _ in range(engine.gradient_accumulation_steps()):
-            batch = mlm_batch(rng, micro)
+            batch = batcher(rng, micro)
             loss = engine(*batch)
             engine.backward(loss)
             engine.step()
@@ -76,6 +152,10 @@ def main():
 
     if jax.process_index() == 0:
         print("final mlm loss:", float(loss))
+    if args.save_checkpoint:
+        path = engine.save_checkpoint(args.save_checkpoint, tag="pretrain")
+        if jax.process_index() == 0:
+            print("checkpoint saved:", path)
 
 
 if __name__ == "__main__":

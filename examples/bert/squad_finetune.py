@@ -70,6 +70,14 @@ def main():
     parser.add_argument("--max-answer-len", type=int, default=30)
     parser.add_argument("--train-file", help="SQuAD v1.1 train json")
     parser.add_argument("--predict-file", help="SQuAD v1.1 dev json")
+    parser.add_argument("--init-checkpoint",
+                        help="initialize the encoder from a pretraining "
+                             "checkpoint dir (pretrain_bert.py "
+                             "--save-checkpoint; pair with the SAME "
+                             "--vocab-file). The fresh QA head keeps its "
+                             "init.")
+    parser.add_argument("--init-tag", default=None,
+                        help="checkpoint tag (default: the dir's latest)")
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
@@ -116,6 +124,41 @@ def main():
     batch_size = (engine.train_micro_batch_size_per_gpu()
                   * engine.dp_world_size
                   * engine.gradient_accumulation_steps())
+
+    if args.init_checkpoint:
+        from deepspeed_tpu import checkpoint as ckpt_mod
+        from deepspeed_tpu.models import BertForPreTraining
+        try:
+            module = ckpt_mod.load_module_tree(args.init_checkpoint,
+                                               tag=args.init_tag)
+        except ValueError:
+            # mp>1/pp>1 pretraining checkpoint: reassemble with the
+            # pretraining model's (shape-free) partition specs
+            module = None
+            for nsp in (False, True):
+                specs = BertForPreTraining.from_size(
+                    "tiny", use_nsp=nsp).partition_specs(None)
+                try:
+                    module = ckpt_mod.load_module_tree(
+                        args.init_checkpoint, tag=args.init_tag,
+                        specs=specs)
+                    break
+                except Exception:
+                    continue
+            if module is None:
+                raise
+        if module is None:
+            raise RuntimeError(
+                f"no checkpoint found under {args.init_checkpoint}")
+        loaded, skipped = ckpt_mod.init_from_module_tree(engine, module)
+        print(f"init-checkpoint: transferred {len(loaded)} leaves, "
+              f"kept init for {len(skipped)} "
+              f"({', '.join(sorted(skipped)[:6])}...)")
+        if not loaded:
+            raise RuntimeError(
+                "init-checkpoint transferred NOTHING — model shape "
+                "mismatch? (seq-len/vocab/hidden must match the "
+                "pretraining run)")
 
     if real:
         feats = squad.featurize(train_exs, tokenizer, seq_len=seq_len,

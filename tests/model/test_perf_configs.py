@@ -35,6 +35,7 @@ PERF_MODELS = {
     "ds_config_perf_1_5b.json": "xl-1.5b-perf",
     "ds_config_perf_4b.json": "4b",
     "ds_config_perf_8b.json": "8b",
+    "ds_config_perf_20b.json": "20b",
 }
 VOCAB = 50304
 SEQ = 1024
@@ -94,10 +95,12 @@ def test_perf_config_schema_and_param_count(name):
     abstract = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
     n = sum(int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(abstract))
-    want = {"ds_config_perf_1_5b.json": 1.5e9,
-            "ds_config_perf_4b.json": 4e9,
-            "ds_config_perf_8b.json": 8e9}[name]
-    assert want <= n <= want * 1.25, f"{name}: {n / 1e9:.2f}B params"
+    lo, hi = {"ds_config_perf_1_5b.json": (1.5e9, 1.7e9),
+              "ds_config_perf_4b.json": (4e9, 4.5e9),
+              "ds_config_perf_8b.json": (8e9, 9e9),
+              # "20B" geometry (111 x 3808) actually lands at ~19.5B
+              "ds_config_perf_20b.json": (19e9, 20.5e9)}[name]
+    assert lo <= n <= hi, f"{name}: {n / 1e9:.2f}B params"
 
 
 def test_1_5b_aot_compiles_sharded_with_memory_envelope():

@@ -209,3 +209,94 @@ def test_latest_tag_and_explicit_tag(tmpdir):
     e3, _ = make_engine(base_config(), seed=5)
     path, _ = e3.load_checkpoint(str(tmpdir), tag="step4")
     assert e3.global_steps == 4
+
+
+# --------------------------------------------- pretrain -> fine-tune transfer
+
+def test_init_from_module_tree_transfers_backbone(tmpdir):
+    """The BingBertSquad workflow: pretrain BERT, save, initialize the QA
+    model's BACKBONE from the checkpoint (fresh task head stays), masters
+    re-derived so the first step doesn't revert the transfer."""
+    from deepspeed_tpu import checkpoint as ckpt_mod
+    from deepspeed_tpu.models import (BertForPreTraining,
+                                      BertForQuestionAnswering)
+
+    kw = dict(vocab_size=64, max_seq_len=32, num_layers=2,
+              hidden_size=32, num_heads=4)
+    pre = BertForPreTraining.from_size("tiny", **kw)
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 4, "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        model=pre, model_parameters=pre.init_params(jax.random.PRNGKey(0)),
+        mesh=make_mesh(devices=jax.devices()[:2]))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, size=(4, 32)).astype(np.int32)
+    mlm = np.where(rng.random((4, 32)) < 0.15, ids, -1).astype(np.int32)
+    for _ in range(2):
+        e1.train_batch((ids, np.ones_like(ids), np.zeros_like(ids), mlm))
+    e1.save_checkpoint(str(tmpdir), tag="pre")
+    want = {jax.tree_util.keystr(k): np.asarray(v) for k, v in
+            jax.tree_util.tree_leaves_with_path(e1.params)}
+
+    module = ckpt_mod.load_module_tree(str(tmpdir), tag="pre")
+    assert module is not None
+
+    qa = BertForQuestionAnswering.from_size("tiny", **kw)
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 4, "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        model=qa, model_parameters=qa.init_params(jax.random.PRNGKey(9)),
+        mesh=make_mesh(devices=jax.devices()[:2]))
+    fresh_qa_w = np.asarray(e2.params["qa_w"])
+    loaded, skipped = ckpt_mod.init_from_module_tree(e2, module)
+    assert any("wte" in k for k in loaded)
+    assert any("blocks" in k for k in loaded)
+    assert all("qa_" in k or "mlm_" in k or "pool" in k or "nsp" in k
+               for k in skipped), skipped
+    # backbone now equals the pretrained weights; the head kept its init
+    for k, v in {jax.tree_util.keystr(kk): vv for kk, vv in
+                 jax.tree_util.tree_leaves_with_path(e2.params)}.items():
+        if k in want and k in loaded:
+            np.testing.assert_array_equal(np.asarray(v), want[k])
+    np.testing.assert_array_equal(np.asarray(e2.params["qa_w"]), fresh_qa_w)
+
+    # masters were re-derived: a training step MOVES from the transferred
+    # weights instead of reverting to the random init
+    before = np.asarray(e2.params["wte"])
+    starts = np.zeros((4,), np.int32)
+    e2.train_batch((ids, np.ones_like(ids), np.zeros_like(ids),
+                    starts, starts + 1))
+    after = np.asarray(e2.params["wte"])
+    assert not np.array_equal(after, before)
+    assert np.abs(after - before).max() < 0.1   # moved FROM the transfer
+
+
+def test_load_module_tree_mp_sharded_needs_specs(tmpdir):
+    """mp>1 checkpoints reassemble through the saving model's specs; the
+    helper refuses to guess."""
+    from deepspeed_tpu import checkpoint as ckpt_mod
+    from deepspeed_tpu.models import GPT2
+
+    model = GPT2.from_size("tiny", vocab_size=64, max_seq_len=16,
+                           num_layers=2, hidden_size=32, num_heads=4)
+    e, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 4, "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=make_mesh(model_parallel_size=2, devices=jax.devices()[:4]))
+    toks = np.zeros((4, 16), np.int32)
+    e.train_batch((toks, toks))
+    e.save_checkpoint(str(tmpdir), tag="mp2")
+
+    with pytest.raises(ValueError, match="partition_specs"):
+        ckpt_mod.load_module_tree(str(tmpdir), tag="mp2")
+    tree = ckpt_mod.load_module_tree(str(tmpdir), tag="mp2",
+                                     specs=model.partition_specs(None))
+    # reassembled to GLOBAL shapes
+    got = {jax.tree_util.keystr(k): v for k, v in
+           jax.tree_util.tree_leaves_with_path(tree)}
+    want = {jax.tree_util.keystr(k): v.shape for k, v in
+            jax.tree_util.tree_leaves_with_path(e.params)}
+    for k, shape in want.items():
+        assert tuple(np.shape(got[k])) == tuple(shape), k
