@@ -479,6 +479,11 @@ class DeepSpeedTpuEngine:
         else:
             self.zero_pps = self.dp_world_size
             self.zero_repl = 1
+        # stage 2 = gradient partitioning (beyond the reference's v0.1.0
+        # stage 1): each micro-step's gradients reduce-scatter into the
+        # owned flat partition INSIDE the accumulation loop, so the
+        # grad-accumulation buffer shrinks from full-size to 1/pps
+        self.zero_stage = self.config.zero_stage if self.zero_enabled else 0
 
         # -- loss scale state
         if self.config.fp16_enabled:
@@ -1109,18 +1114,45 @@ class DeepSpeedTpuEngine:
 
         return loss_and_grads
 
+    def _scatter_grads_local(self, grads, rows: bool = None,
+                             across_subgroups: bool = True):
+        """Flatten this shard's grad tree and reduce-scatter onto the
+        owned flat partition — the ZeRO boundary reduction, also run
+        per micro-step under stage 2 (linearity makes per-micro
+        scatter-then-accumulate equal accumulate-then-scatter; the
+        stage-2 path defers the cross-sub-group psum to the boundary).
+        ``rows=True`` wraps the result in the [1, part] per-row layout
+        (default: when MP/PP state axes exist)."""
+        cfg = self.config
+        flat = zero_mod.flatten_tree(grads, self.flat_meta)
+        gpart = comm.reduce_scatter_grads(
+            flat, DATA_AXIS, self.dp_world_size,
+            fp32_allreduce=cfg.fp32_allreduce,
+            prescale_gradients=cfg.prescale_gradients,
+            gradient_predivide_factor=cfg.gradient_predivide_factor,
+            partition_group_size=self.zero_pps,
+            across_subgroups=across_subgroups)
+        if rows is None:
+            rows = bool(self._zero_state_axes)
+        return gpart[None] if rows else gpart
+
     def _build_fwdbwd(self, batch):
         loss_and_grads = self._make_loss_and_grads()
+        stage2 = self.zero_stage >= 2
 
         def local(params, ls_scale, batch_args):
             loss_out, grads = loss_and_grads(params, ls_scale, batch_args)
+            if stage2:
+                return loss_out, self._scatter_grads_local(
+                    grads, across_subgroups=False)
             return loss_out, jax.tree_util.tree_map(
                 lambda g: g[None], grads)
 
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._param_specs, P(), self._batch_specs(batch)),
-            out_specs=(P(), self._grad_stack_specs()),
+            out_specs=(P(), self._zero_flat_spec() if stage2
+                       else self._grad_stack_specs()),
             check_vma=False)
         return jax.jit(fn)
 
@@ -1277,6 +1309,7 @@ class DeepSpeedTpuEngine:
         clip = self.clip_grad
         variant = self._ls_variant
         zero = self.zero_enabled
+        stage2 = self.zero_stage >= 2
         mp = self.mp_world_size
         state_axes = list(self._zero_state_axes)
         zero_2d = zero and bool(state_axes)
@@ -1308,13 +1341,15 @@ class DeepSpeedTpuEngine:
                            if opt_state.v is not None else None))
                 else:
                     master_1d, opt_in = master, opt_state
-                flat_local = zero_mod.flatten_tree(grads, meta)
-                gpart = comm.reduce_scatter_grads(
-                    flat_local, DATA_AXIS, world,
-                    fp32_allreduce=cfg.fp32_allreduce,
-                    prescale_gradients=cfg.prescale_gradients,
-                    gradient_predivide_factor=cfg.gradient_predivide_factor,
-                    partition_group_size=pps)
+                if stage2:
+                    # grads arrive reduced+scattered within each sub-group
+                    # (per-micro, inside the accumulation loop); finish
+                    # the single deferred cross-sub-group psum here
+                    gpart = grads[0] if zero_2d else grads
+                    gpart = comm.finish_subgroup_reduce(
+                        gpart, DATA_AXIS, world, pps)
+                else:
+                    gpart = self._scatter_grads_local(grads, rows=False)
                 overflow = comm.overflow_any(
                     jnp.logical_not(jnp.all(jnp.isfinite(gpart))), DATA_AXIS)
                 if zero_2d:
@@ -1463,17 +1498,24 @@ class DeepSpeedTpuEngine:
 
     def _build_step(self):
         step_local = self._make_step_local()
+        stage2 = self.zero_stage >= 2
 
         def local(master, opt_state, acc, ls_state, lr, b1, b2, wd, normw):
-            # acc leaves arrive as [1, ...] local slices
-            grads = jax.tree_util.tree_map(lambda g: g[0], acc)
+            if stage2:
+                # acc IS the accumulated flat partition (ZeRO-2)
+                grads = acc
+            else:
+                # acc leaves arrive as [1, ...] local slices
+                grads = jax.tree_util.tree_map(lambda g: g[0], acc)
             return step_local(master, opt_state, grads, ls_state, lr, b1, b2,
                               wd, normw)
 
         master_spec, opt_spec, ls_spec = self._step_specs()
         fn = jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(master_spec, opt_spec, self._grad_stack_specs(),
+            in_specs=(master_spec, opt_spec,
+                      self._zero_flat_spec() if stage2
+                      else self._grad_stack_specs(),
                       ls_spec, P(), P(), P(), P(), P(DATA_AXIS)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P()),
@@ -1641,6 +1683,7 @@ class DeepSpeedTpuEngine:
         gas = self.gradient_accumulation_steps()
         loss_and_grads = self._make_loss_and_grads()
         step_local = self._make_step_local()
+        stage2 = self.zero_stage >= 2
 
         def local(params, master, opt_state, ls_state, lr, b1, b2, wd,
                   normw, batch_args):
@@ -1648,6 +1691,9 @@ class DeepSpeedTpuEngine:
                 # no accumulator buffer, no scan machinery
                 last_loss, acc = loss_and_grads(
                     params, ls_state.cur_scale, batch_args)
+                if stage2:
+                    acc = self._scatter_grads_local(
+                        acc, across_subgroups=False)
             else:
                 # fold the grad-accum axis out front for the scan; batch
                 # leaves arrive as local [gas * micro_local, ...] slices
@@ -1659,11 +1705,23 @@ class DeepSpeedTpuEngine:
                 def body(acc, micro):
                     loss_out, grads = loss_and_grads(
                         params, ls_state.cur_scale, micro)
+                    if stage2:
+                        # ZeRO-2: scatter per micro — the accumulator is
+                        # the 1/pps flat partition, not a full grad tree
+                        # (cross-sub-group psum deferred to the boundary)
+                        grads = self._scatter_grads_local(
+                            grads, across_subgroups=False)
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                     return acc, loss_out
 
-                zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                if stage2:
+                    part = self.flat_meta.partition
+                    shape = ((1, part) if self._zero_state_axes
+                             else (part,))
+                    zeros = jnp.zeros(shape, jnp.float32)
+                else:
+                    zeros = jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 acc, losses = jax.lax.scan(body, zeros, mb)
                 last_loss = jax.tree_util.tree_map(lambda l: l[-1], losses)
             (params_new, master_new, opt_new, ls_new, overflow,

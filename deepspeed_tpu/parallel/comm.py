@@ -105,8 +105,8 @@ def reduce_scatter_grads(flat_grad: jnp.ndarray,
                          fp32_allreduce: bool = False,
                          prescale_gradients: bool = False,
                          gradient_predivide_factor: float = 1.0,
-                         partition_group_size: Optional[int] = None
-                         ) -> jnp.ndarray:
+                         partition_group_size: Optional[int] = None,
+                         across_subgroups: bool = True) -> jnp.ndarray:
     """Reduce-scatter a flat gradient over the DP axis, returning this rank's
     partition (flat_grad length must be divisible by the partition group).
 
@@ -121,6 +121,9 @@ def reduce_scatter_grads(flat_grad: jnp.ndarray,
     consecutive g-rank sub-group and the partial sums then psum across
     sub-groups, so every rank ends with the FULL-DP-reduced gradient of its
     sub-partition (replicated across the world/g sub-groups).
+    ``across_subgroups=False`` skips that cross-group psum — callers that
+    accumulate several scatters (ZeRO-2's per-micro path) defer the single
+    linear psum to the boundary via ``finish_subgroup_reduce``.
     """
     if partition_group_size is None or partition_group_size == world_size:
         reduce_fn = lambda x: lax.psum_scatter(
@@ -132,6 +135,8 @@ def reduce_scatter_grads(flat_grad: jnp.ndarray,
         def reduce_fn(x):
             part = lax.psum_scatter(x, axis_name, scatter_dimension=0,
                                     tiled=True, axis_index_groups=within)
+            if not across_subgroups:
+                return part
             return lax.psum(part, axis_name, axis_index_groups=across)
 
     return scaled_reduce(
@@ -141,6 +146,17 @@ def reduce_scatter_grads(flat_grad: jnp.ndarray,
         fp32_allreduce=fp32_allreduce,
         prescale_gradients=prescale_gradients,
         gradient_predivide_factor=gradient_predivide_factor)
+
+
+def finish_subgroup_reduce(partition: jnp.ndarray, axis_name: str,
+                           world_size: int,
+                           partition_group_size: int) -> jnp.ndarray:
+    """The deferred cross-sub-group psum of ``reduce_scatter_grads(...,
+    across_subgroups=False)`` — run ONCE on the accumulated partition."""
+    if partition_group_size == world_size:
+        return partition
+    _, across = subgroup_index_groups(world_size, partition_group_size)
+    return lax.psum(partition, axis_name, axis_index_groups=across)
 
 
 def allgather_params(partition: jnp.ndarray, axis_name: str,
