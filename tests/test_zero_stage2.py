@@ -112,6 +112,28 @@ def test_stage2_with_mp_and_pps():
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-3)
 
 
+def test_stage2_with_pipeline():
+    """Stage 2 under pp=2: the per-(stage, shard) [1, part] rows scatter
+    per micro and match the stage-1 trajectory."""
+    from deepspeed_tpu.models import GPT2Pipelined
+
+    def run(stage):
+        model = GPT2Pipelined.from_size(
+            "tiny", vocab_size=VOCAB, max_seq_len=SEQ, num_layers=2,
+            hidden_size=32, num_heads=4, num_micro_batches=2)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config={"train_batch_size": 8, "steps_per_print": 10 ** 6,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": stage},
+                    "fp16": {"enabled": True, "initial_scale_power": 8}},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(7)),
+            mesh=make_mesh(pipeline_parallel_size=2))
+        return run_fused(engine)
+
+    np.testing.assert_allclose(run(2), run(1), rtol=2e-3, atol=1e-3)
+
+
 def test_stage2_shrinks_grad_accumulator():
     """The point of stage 2: the LIVE grad accumulator a device holds
     between micro-steps is the 1/dp flat partition, not a replicated
