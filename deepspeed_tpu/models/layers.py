@@ -39,10 +39,47 @@ from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 #   seq 512:               streaming kernel  ~parity     -> XLA
 #   seq 1024:              streaming kernel  1.67x FASTER
 #   seq 2048:              streaming kernel  1.49x FASTER
-# "auto" (default) uses the online-softmax streaming kernel from
-# STREAM_AUTO_MIN tokens up, XLA below; "1" forces a kernel wherever one
+# "auto" (default) uses the online-softmax streaming kernel from the
+# calibrated threshold up, XLA below; "1" forces a kernel wherever one
 # supports the shape; "0" disables both.
+#
+# The crossover is chip-generation dependent (the 1024 figure is the v5e
+# sweep; faster MXUs shift it).  Resolution order for the auto threshold:
+#   1. DSTPU_STREAM_ATTN_MIN env (an operator pin / calibrate() result)
+#   2. the per-device-kind table below
+#   3. the v5e-measured default (1024)
+# `ops.pallas_attention.calibrate_stream_threshold()` measures the
+# crossover on the attached chip and prints the env pin to persist.
 STREAM_AUTO_MIN = 1024
+#: measured per device kind; extend as sweeps run on new generations
+#: (BENCH_ATTN_SWEEP=1 BENCH_SEQ=<n> python bench.py)
+STREAM_AUTO_MIN_BY_KIND = {
+    "TPU v5 lite": 1024,
+    "TPU v5e": 1024,
+}
+
+
+def stream_auto_min() -> int:
+    """The auto-dispatch threshold for the CURRENT backend (see the
+    resolution order above)."""
+    env = os.environ.get("DSTPU_STREAM_ATTN_MIN")
+    if env:
+        try:
+            v = int(env)
+        except ValueError:
+            raise ValueError(
+                f"DSTPU_STREAM_ATTN_MIN={env!r} is not an integer token "
+                "count") from None
+        if v <= 0:
+            raise ValueError(
+                f"DSTPU_STREAM_ATTN_MIN={env!r} must be a positive token "
+                "count")
+        return v
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return STREAM_AUTO_MIN
+    return STREAM_AUTO_MIN_BY_KIND.get(kind, STREAM_AUTO_MIN)
 
 
 def _attn_mode() -> str:
@@ -52,8 +89,9 @@ def _attn_mode() -> str:
         # the kernel the operator meant to disable
         raise ValueError(
             f"DSTPU_FUSED_ATTN={mode!r} is not a valid mode: use 'auto' "
-            f"(streaming kernel from {STREAM_AUTO_MIN} tokens), '1' "
-            f"(force a kernel), or '0' (XLA only)")
+            f"(streaming kernel from the calibrated threshold, "
+            f"DSTPU_STREAM_ATTN_MIN), '1' (force a kernel), or '0' "
+            f"(XLA only)")
     return mode
 
 
@@ -215,7 +253,7 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
     if mode != "0" and jax.default_backend() == "tpu":
         from deepspeed_tpu.ops import pallas_attention as pattn
         use_stream = pattn.stream_supported(T, d) and (
-            mode == "1" or T >= STREAM_AUTO_MIN)
+            mode == "1" or T >= stream_auto_min())
         use_block = (not use_stream and mode == "1"
                      and pattn.supported(T, n_local, d))
         if use_stream or use_block:

@@ -499,3 +499,85 @@ def _stream_vjp_bwd(causal, interpret, res, g):
 
 
 stream_attention.defvjp(_stream_vjp_fwd, _stream_vjp_bwd)
+
+
+def calibrate_stream_threshold(seq_lens=(256, 512, 1024, 2048),
+                               batch=8, n_heads=12, head_dim=64,
+                               steps=6, verbose=True):
+    """Measure the streaming-kernel vs XLA crossover on the ATTACHED chip
+    and return the smallest winning sequence length.
+
+    The shipped auto-dispatch threshold encodes the v5e sweep
+    (models/layers.py STREAM_AUTO_MIN); other chip generations shift the
+    crossover.  This times fwd+bwd of both paths at each length and
+    returns the first where the kernel is >= 5% faster (falling back to
+    the table default when none wins).  Persist the result with::
+
+        export DSTPU_STREAM_ATTN_MIN=<returned value>
+
+    Host-side utility; requires a TPU backend.
+    """
+    import time
+
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        raise RuntimeError(
+            "calibrate_stream_threshold needs a TPU backend (the kernel "
+            "never dispatches off-TPU)")
+
+    def time_path(T, use_kernel):
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.normal(size=(batch, T, n_heads,
+                                                head_dim)),
+                               jnp.bfloat16) for _ in range(3))
+        mask = jnp.ones((batch, T), jnp.float32)
+
+        def xla_attn(q, k, v):
+            s = jnp.einsum("btnd,bsnd->bnts", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+            cm = jnp.tril(jnp.ones((T, T), jnp.bool_))
+            s = jnp.where(cm[None, None], s, -1e9)
+            p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+            return jnp.einsum("bnts,bsnd->btnd", p, v)
+
+        def loss(q, k, v):
+            o = (stream_attention(q, k, v, mask, True) if use_kernel
+                 else xla_attn(q, k, v))
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        fn(q, k, v)[0].block_until_ready()           # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, k, v)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        return (time.perf_counter() - t0) / steps
+
+    from deepspeed_tpu.models import layers as _L
+
+    threshold = None
+    for T in sorted(seq_lens):
+        if not stream_supported(T, head_dim):
+            continue
+        t_xla = time_path(T, use_kernel=False)
+        t_ker = time_path(T, use_kernel=True)
+        ratio = t_xla / t_ker
+        if verbose:
+            print(f"seq {T}: xla {t_xla * 1e3:.2f} ms, "
+                  f"kernel {t_ker * 1e3:.2f} ms, {ratio:.2f}x")
+        if threshold is None and ratio >= 1.05:
+            threshold = T
+    if threshold is None:
+        # deliberately IGNORE any existing env pin here: this measurement
+        # just showed the kernel losing, so fall back to the table/default
+        kind = jax.devices()[0].device_kind
+        threshold = _L.STREAM_AUTO_MIN_BY_KIND.get(kind,
+                                                   _L.STREAM_AUTO_MIN)
+        if verbose:
+            print(f"kernel never won >=1.05x; keeping {threshold}")
+    elif verbose:
+        print(f"crossover at seq {threshold}: "
+              f"export DSTPU_STREAM_ATTN_MIN={threshold}")
+    return threshold
