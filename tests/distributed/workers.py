@@ -161,6 +161,45 @@ def zero_pps_ckpt_resume():
     assert post == ref_losses[4:], (post, ref_losses[4:])
 
 
+# ---------------------------------------------------------------- scenario 2d
+
+def zero2_ckpt_resume():
+    """ZeRO stage 2 across real processes: per-micro scattered grad
+    accumulation (gas=2) trains, checkpoints with the stage-1 file
+    layout, and resumes to the unbroken trajectory."""
+    ckdir = _test_dir()
+    cfg = dict(_ZERO_CFG)
+    cfg["zero_optimization"] = {"stage": 2}
+    cfg["train_batch_size"] = 16
+    cfg["gradient_accumulation_steps"] = 2
+
+    def make_engine():
+        engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=8),
+                                        config=dict(cfg))
+        assert engine.zero_stage == 2
+        return engine
+
+    def step2(engine, i):
+        rng = np.random.default_rng(200 + i)
+        x = rng.normal(size=(16, 8)).astype(np.float16)
+        y = rng.integers(0, 8, size=(16,)).astype(np.int32)
+        return float(engine.train_batch((x, y)))
+
+    unbroken = make_engine()
+    ref = [step2(unbroken, i) for i in range(5)]
+
+    saver = make_engine()
+    pre = [step2(saver, i) for i in range(3)]
+    assert pre == ref[:3], (pre, ref)
+    saver.save_checkpoint(ckdir, tag="z2")
+
+    resumed = make_engine()
+    path, _ = resumed.load_checkpoint(ckdir, tag="z2")
+    assert path is not None
+    post = [step2(resumed, i) for i in (3, 4)]
+    assert post == ref[3:], (post, ref[3:])
+
+
 # ---------------------------------------------------------------- scenario 2c
 
 def zero_pps_mp_ckpt_resume():
