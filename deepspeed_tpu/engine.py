@@ -519,11 +519,17 @@ class DeepSpeedTpuEngine:
                                                         model_parameters)
         self._group_defs, self._group_ids = self._resolve_param_groups(
             param_groups, model_parameters)
-        if self.zero_enabled and len(self._group_defs) > 1:
+        # per-group hypers under ZeRO expand to PER-ELEMENT vectors over
+        # the flat partition (the upstream Adam-family guard already
+        # excludes per-tensor-rule optimizers like LAMB); only the 2-D
+        # [S, local] layout lacks its per-row group-id maps
+        if (self.zero_enabled and len(self._group_defs) > 1
+                and self._zero_state_axes):
             raise DeepSpeedConfigError(
-                "param_groups with ZeRO is not supported: the flat "
-                "partition buffer carries one LR (the reference likewise "
-                "builds its ZeRO optimizer from a single flat group)")
+                "param_groups with ZeRO x model/pipeline parallelism "
+                "is not supported yet: the per-row [S, local] group-id "
+                "maps are not built (drop param_groups or the "
+                "model/pipeline axes)")
         self._init_parameters(model_parameters)
 
         # -- optimizer state
@@ -711,6 +717,17 @@ class DeepSpeedTpuEngine:
             self.master_flat = jax.device_put(flat, self._named(P(DATA_AXIS)))
             self.master = None
             self._zero_norm_w = None
+            if len(self._group_defs) > 1:
+                # per-element group ids over the flat layout: hypers
+                # expand as vec[gid] inside the partitioned update
+                gids = np.concatenate(
+                    [np.full(size, g, np.int32) for g, size in
+                     zip(jax.tree_util.tree_leaves(self._group_ids),
+                         self.flat_meta.sizes)]
+                    + [np.zeros(self.flat_meta.padded
+                                - self.flat_meta.total, np.int32)])
+                self._zero_gid_flat = jax.device_put(
+                    self._tile_flat(gids), self._named(P(DATA_AXIS)))
         else:
             self.flat_meta = None
             self.master_flat = None
@@ -723,6 +740,10 @@ class DeepSpeedTpuEngine:
             # static; dead in every non-(ZeRO x MP) branch, DCE'd by XLA
             self._zero_norm_w = jax.device_put(
                 jnp.zeros((self.dp_world_size,), jnp.float32),
+                self._named(P(DATA_AXIS)))
+        if getattr(self, "_zero_gid_flat", None) is None:
+            self._zero_gid_flat = jax.device_put(
+                jnp.zeros((self.dp_world_size,), jnp.int32),
                 self._named(P(DATA_AXIS)))
 
         cdt = self.policy.compute_dtype
@@ -1321,11 +1342,15 @@ class DeepSpeedTpuEngine:
         multi_group = len(self._group_defs) > 1
 
         def step_local(master, opt_state, grads, ls_state, lr, b1, b2, wd,
-                       normw):
+                       normw, gids):
             # hypers arrive as [G] vectors (one per param group); expand to
-            # per-leaf trees when groups exist, else the plain scalars
-            if zero or not multi_group:
+            # per-leaf trees when groups exist (per-ELEMENT vectors over
+            # the flat partition under ZeRO), else the plain scalars
+            if not multi_group:
                 lr, b1, b2, wd = lr[0], b1[0], b2[0], wd[0]
+            elif zero:
+                expand = lambda vec: {"flat": vec[gids]}
+                lr, b1, b2, wd = expand(lr), expand(b1), expand(b2), expand(wd)
             else:
                 expand = lambda vec: jax.tree_util.tree_map(
                     lambda gid: vec[gid], group_ids)
@@ -1500,7 +1525,8 @@ class DeepSpeedTpuEngine:
         step_local = self._make_step_local()
         stage2 = self.zero_stage >= 2
 
-        def local(master, opt_state, acc, ls_state, lr, b1, b2, wd, normw):
+        def local(master, opt_state, acc, ls_state, lr, b1, b2, wd, normw,
+                  gids):
             if stage2:
                 # acc IS the accumulated flat partition (ZeRO-2)
                 grads = acc
@@ -1508,7 +1534,7 @@ class DeepSpeedTpuEngine:
                 # acc leaves arrive as [1, ...] local slices
                 grads = jax.tree_util.tree_map(lambda g: g[0], acc)
             return step_local(master, opt_state, grads, ls_state, lr, b1, b2,
-                              wd, normw)
+                              wd, normw, gids)
 
         master_spec, opt_spec, ls_spec = self._step_specs()
         fn = jax.shard_map(
@@ -1516,7 +1542,8 @@ class DeepSpeedTpuEngine:
             in_specs=(master_spec, opt_spec,
                       self._zero_flat_spec() if stage2
                       else self._grad_stack_specs(),
-                      ls_spec, P(), P(), P(), P(), P(DATA_AXIS)),
+                      ls_spec, P(), P(), P(), P(), P(DATA_AXIS),
+                      P(DATA_AXIS)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P()),
             check_vma=False)
@@ -1644,7 +1671,7 @@ class DeepSpeedTpuEngine:
             (self.params, new_master, self.opt_state, self.loss_scale_state,
              overflow, self._last_grad_norm) = self._step_fn(
                 master, self.opt_state, self._acc, self.loss_scale_state,
-                lr, b1, b2, wd, self._zero_norm_w)
+                lr, b1, b2, wd, self._zero_norm_w, self._zero_gid_flat)
             if self.zero_enabled:
                 self.master_flat = new_master
             else:
@@ -1686,7 +1713,7 @@ class DeepSpeedTpuEngine:
         stage2 = self.zero_stage >= 2
 
         def local(params, master, opt_state, ls_state, lr, b1, b2, wd,
-                  normw, batch_args):
+                  normw, gids, batch_args):
             if gas == 1:
                 # no accumulator buffer, no scan machinery
                 last_loss, acc = loss_and_grads(
@@ -1726,7 +1753,7 @@ class DeepSpeedTpuEngine:
                 last_loss = jax.tree_util.tree_map(lambda l: l[-1], losses)
             (params_new, master_new, opt_new, ls_new, overflow,
              total_norm) = step_local(master, opt_state, acc, ls_state,
-                                      lr, b1, b2, wd, normw)
+                                      lr, b1, b2, wd, normw, gids)
             return (params_new, master_new, opt_new, ls_new, overflow,
                     total_norm, last_loss)
 
@@ -1734,7 +1761,7 @@ class DeepSpeedTpuEngine:
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
-                      P(), P(), P(), P(), P(DATA_AXIS),
+                      P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
                       self._batch_specs(batch)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P(), P()),
@@ -1779,7 +1806,8 @@ class DeepSpeedTpuEngine:
         (self.params, new_master, self.opt_state, self.loss_scale_state,
          overflow, self._last_grad_norm, loss) = self._train_batch_fn(
             self.params, master, self.opt_state, self.loss_scale_state,
-            lr, b1, b2, wd, self._zero_norm_w, batch)
+            lr, b1, b2, wd, self._zero_norm_w, self._zero_gid_flat,
+            batch)
         if self.zero_enabled:
             self.master_flat = new_master
         else:

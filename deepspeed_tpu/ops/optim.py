@@ -110,14 +110,19 @@ class Optimizer:
         raise NotImplementedError
 
     @staticmethod
+    def _is_scalar_hyper(h) -> bool:
+        """One shared scalar test (None / python number / 0-d array)."""
+        return (h is None or isinstance(h, (int, float))
+                or getattr(h, "ndim", 1) == 0)
+
+    @staticmethod
     def _hyper_leaves(val, treedef, n):
         """A hyperparameter (lr/beta1/beta2/weight_decay) may be a scalar
         (all leaves share it) or a pytree matching params (per-leaf values —
         the engine's param-group path, reference torch param groups carrying
         arbitrary hypers, deepspeed_fused_lamb.py:77-100).  Returns a flat
         list of per-leaf scalars (None = use the optimizer's default)."""
-        if val is None or isinstance(val, (int, float)) or (
-                hasattr(val, "ndim") and val.ndim == 0):
+        if Optimizer._is_scalar_hyper(val):
             return [val] * n
         return treedef.flatten_up_to(val)
 
@@ -162,8 +167,15 @@ class Adam(Optimizer):
             lr_l, b1, b2, wd = self._resolve(*hy)
             step_size = self._step_size(lr_l, step.astype(jnp.float32),
                                         b1, b2)
+            # per-ELEMENT hyper arrays (ZeRO x param_groups expands
+            # vec[gid] over the flat partition) take the jnp path — the
+            # Pallas kernel is compiled for scalar hypers.  Known trade:
+            # grouped ZeRO loses the fused update on the flat buffer; a
+            # kernel variant taking a gid vector would recover it.
+            scalar_hy = all(self._is_scalar_hyper(h)
+                            for h in (lr_l, b1, b2, wd))
             from deepspeed_tpu.ops import pallas_optim as pk
-            if pk.should_use_pallas(p.size, self.use_pallas):
+            if scalar_hy and pk.should_use_pallas(p.size, self.use_pallas):
                 return pk.fused_adam_update(
                     p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
                     weight_decay=wd,

@@ -108,12 +108,85 @@ def test_train_batch_fused_with_groups():
                                1.0 - 0.01, rtol=1e-6)
 
 
-def test_zero_rejects_param_groups():
-    with pytest.raises(DeepSpeedConfigError, match="param_groups"):
+def test_zero_param_groups_per_element_lrs():
+    """param_groups now compose with ZeRO (Adam family): hypers expand to
+    per-ELEMENT vectors over the flat partition.  grad == 1 everywhere,
+    so after one step each leaf moved by exactly its group's Adam step
+    (~lr), and an lr=0 group must not move at all."""
+    engine, opt, _ = make_engine(
+        param_groups=[{"params": "head", "lr": 0.0}],
+        zero_optimization=True,
+        optimizer={"type": "Adam", "params": {"lr": 0.1}},
+        bf16={"enabled": True})
+    assert engine.zero_enabled and len(opt.param_groups) == 2
+    step_once(engine)
+    # read the leaves back through the flat master
+    from deepspeed_tpu import zero as zero_mod
+    flat = np.asarray(jax.device_get(engine.master_flat))
+    tree = zero_mod.unflatten_tree(
+        jnp.asarray(engine._untile_flat(flat)), engine.flat_meta)
+    body = np.asarray(tree["body"])
+    head = np.asarray(tree["head"])
+    np.testing.assert_allclose(head, 1.0, atol=1e-7)        # lr 0: frozen
+    np.testing.assert_allclose(body, 1.0 - 0.1, atol=1e-3)  # Adam ~ -lr
+
+
+def test_zero_param_groups_match_nonzero_trajectory():
+    """ZeRO x param_groups trajectory == the replicated engine with the
+    same groups (partitioned per-element hypers are numerics-equal)."""
+    def run(zero):
+        cfg = dict(param_groups=[{"params": "head", "lr": 0.02,
+                                  "weight_decay": 0.0}],
+                   optimizer={"type": "AdamW",
+                              "params": {"lr": 0.1, "weight_decay": 0.1}},
+                   bf16={"enabled": True})
+        if zero:
+            cfg["zero_optimization"] = True
+        engine, _, _ = make_engine(**cfg)
+        for _ in range(3):
+            step_once(engine)
+        if zero:
+            from deepspeed_tpu import zero as zero_mod
+            flat = np.asarray(jax.device_get(engine.master_flat))
+            tree = zero_mod.unflatten_tree(
+                jnp.asarray(engine._untile_flat(flat)), engine.flat_meta)
+        else:
+            tree = engine.master
+        return (np.asarray(tree["body"]), np.asarray(tree["head"]))
+
+    b0, h0 = run(zero=False)
+    b1, h1 = run(zero=True)
+    np.testing.assert_allclose(b1, b0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h1, h0, rtol=1e-5, atol=1e-6)
+
+
+def test_zero_rejects_lamb_with_or_without_groups():
+    """ZeRO stays Adam-family (the reference guard): LAMB's per-tensor
+    trust ratio has no flat-partition form, groups or not."""
+    with pytest.raises(DeepSpeedConfigError, match="Adam-family"):
         make_engine(param_groups=[{"params": "head", "lr": 0.01}],
                     zero_optimization=True,
-                    optimizer={"type": "Adam", "params": {"lr": 0.1}},
+                    optimizer={"type": "Lamb", "params": {"lr": 0.1}},
                     fp16={"enabled": True, "initial_scale_power": 8})
+
+
+def test_zero_mp_rejects_param_groups():
+    """The per-row [S, local] group-id maps aren't built: ZeRO x MP with
+    groups errors loudly instead of silently using group-0 hypers."""
+    from deepspeed_tpu.models import GPT2
+    from deepspeed_tpu.parallel.topology import make_mesh
+    model = GPT2.from_size("tiny", vocab_size=64, max_seq_len=16,
+                           num_layers=2, hidden_size=32, num_heads=4)
+    with pytest.raises(DeepSpeedConfigError, match="model/pipeline"):
+        deepspeed_tpu.initialize(
+            config={"train_batch_size": 8, "steps_per_print": 10 ** 6,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": True,
+                    "bf16": {"enabled": True}},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            param_groups=[{"params": "wte", "lr": 0.01}],
+            mesh=make_mesh(model_parallel_size=2))
 
 
 def test_entry_without_pattern_rejected():
