@@ -519,17 +519,6 @@ class DeepSpeedTpuEngine:
                                                         model_parameters)
         self._group_defs, self._group_ids = self._resolve_param_groups(
             param_groups, model_parameters)
-        # per-group hypers under ZeRO expand to PER-ELEMENT vectors over
-        # the flat partition (the upstream Adam-family guard already
-        # excludes per-tensor-rule optimizers like LAMB); only the 2-D
-        # [S, local] layout lacks its per-row group-id maps
-        if (self.zero_enabled and len(self._group_defs) > 1
-                and self._zero_state_axes):
-            raise DeepSpeedConfigError(
-                "param_groups with ZeRO x model/pipeline parallelism "
-                "is not supported yet: the per-row [S, local] group-id "
-                "maps are not built (drop param_groups or the "
-                "model/pipeline axes)")
         self._init_parameters(model_parameters)
 
         # -- optimizer state
@@ -717,17 +706,6 @@ class DeepSpeedTpuEngine:
             self.master_flat = jax.device_put(flat, self._named(P(DATA_AXIS)))
             self.master = None
             self._zero_norm_w = None
-            if len(self._group_defs) > 1:
-                # per-element group ids over the flat layout: hypers
-                # expand as vec[gid] inside the partitioned update
-                gids = np.concatenate(
-                    [np.full(size, g, np.int32) for g, size in
-                     zip(jax.tree_util.tree_leaves(self._group_ids),
-                         self.flat_meta.sizes)]
-                    + [np.zeros(self.flat_meta.padded
-                                - self.flat_meta.total, np.int32)])
-                self._zero_gid_flat = jax.device_put(
-                    self._tile_flat(gids), self._named(P(DATA_AXIS)))
         else:
             self.flat_meta = None
             self.master_flat = None
@@ -741,7 +719,22 @@ class DeepSpeedTpuEngine:
             self._zero_norm_w = jax.device_put(
                 jnp.zeros((self.dp_world_size,), jnp.float32),
                 self._named(P(DATA_AXIS)))
-        if getattr(self, "_zero_gid_flat", None) is None:
+        if self.zero_enabled and len(self._group_defs) > 1:
+            # per-element group ids over the flat layout: hypers expand as
+            # vec[gid] inside the partitioned update.  meta.sizes are the
+            # LOCAL slice sizes under MP/PP (identical for every
+            # (stage, shard) row — uniform sharding), so ONE data-sharded
+            # vector serves the 1-D and the [S, local] layouts alike.
+            gids = np.concatenate(
+                [np.full(size, g, np.int32) for g, size in
+                 zip(jax.tree_util.tree_leaves(self._group_ids),
+                     self.flat_meta.sizes)]
+                + [np.zeros(self.flat_meta.padded - self.flat_meta.total,
+                            np.int32)])
+            self._zero_gid_flat = jax.device_put(
+                self._tile_flat(gids), self._named(P(DATA_AXIS)))
+        else:
+            # dummy with static arity, dead in every other branch
             self._zero_gid_flat = jax.device_put(
                 jnp.zeros((self.dp_world_size,), jnp.int32),
                 self._named(P(DATA_AXIS)))
