@@ -174,6 +174,32 @@ def test_stage2_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(post, ref[3:], rtol=1e-5)
 
 
+def test_stage2_with_param_groups():
+    """Stage 2 x param_groups: the per-element gid expansion applies to
+    the per-micro scattered partition — an lr=0 group stays frozen."""
+    model = tiny_gpt2()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 16, "gradient_accumulation_steps": 2,
+                "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "bf16": {"enabled": True}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(7)),
+        param_groups=[{"params": "wpe", "lr": 0.0}],
+        mesh=make_mesh())
+    init_wpe = np.asarray(model.init_params(
+        jax.random.PRNGKey(7))["wpe"], np.float32)
+    for i in range(2):
+        engine.train_batch(lm_batch(16, seed=i))
+    got = np.asarray(engine.params["wpe"], np.float32)
+    np.testing.assert_allclose(got, init_wpe, atol=1e-3)
+    assert not np.allclose(
+        np.asarray(engine.params["wte"], np.float32),
+        np.asarray(model.init_params(jax.random.PRNGKey(7))["wte"],
+                   np.float32), atol=1e-4)
+
+
 @pytest.mark.fast
 def test_stage3_rejected():
     with pytest.raises(DeepSpeedConfigError, match="stage"):
