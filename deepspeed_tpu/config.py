@@ -165,11 +165,22 @@ class DeepSpeedConfig:
         self.optimizer_name = None
         self.optimizer_params = None
         self.optimizer_legacy_fusion = False
+        self.optimizer_param_groups = None
         if opt is not None:
             name = opt.get(C.OPTIMIZER_TYPE, None)
             self.optimizer_name = name.lower() if isinstance(name, str) else name
             self.optimizer_params = dict(opt.get(C.OPTIMIZER_PARAMS, {}))
             self.optimizer_legacy_fusion = bool(opt.get("legacy_fusion", False))
+            # pure-JSON spelling of initialize(param_groups=...) — same
+            # entry dicts ({"params": <path regex>, "lr": ..., ...})
+            groups = opt.get("param_groups", None)
+            if groups is not None:
+                if (not isinstance(groups, (list, tuple))
+                        or not all(isinstance(g, Mapping) for g in groups)):
+                    raise DeepSpeedConfigError(
+                        "optimizer.param_groups must be a list of group "
+                        "dicts ({'params': <pytree-path regex>, ...})")
+                self.optimizer_param_groups = [dict(g) for g in groups]
 
         sched = pd.get(C.SCHEDULER, None)
         self.scheduler_name = None

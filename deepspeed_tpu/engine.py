@@ -517,6 +517,12 @@ class DeepSpeedTpuEngine:
         self._param_specs = self._resolve_param_specs(model, model_parameters)
         self._sparse_flags = self._resolve_sparse_flags(model,
                                                         model_parameters)
+        if param_groups is None and self.client_optimizer is None:
+            # pure-JSON spelling (optimizer.param_groups); the explicit
+            # initialize(param_groups=...) argument beats it, and a
+            # client optimizer object disables the whole JSON optimizer
+            # section (docs/config.md) — groups included
+            param_groups = self.config.optimizer_param_groups
         self._group_defs, self._group_ids = self._resolve_param_groups(
             param_groups, model_parameters)
         self._init_parameters(model_parameters)

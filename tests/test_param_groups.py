@@ -75,6 +75,27 @@ def test_scheduler_drives_groups_independently():
     np.testing.assert_allclose(lr0 / lr1, 10.0, rtol=1e-6)
 
 
+def test_param_groups_from_config_json():
+    """The pure-JSON spelling (optimizer.param_groups) matches the API
+    path; an explicit initialize(param_groups=...) beats it."""
+    engine, opt, _ = make_engine(
+        optimizer={"type": "SGD", "params": {"lr": 0.1},
+                   "param_groups": [{"params": "head", "lr": 0.01}]})
+    assert len(opt.param_groups) == 2
+    step_once(engine)
+    np.testing.assert_allclose(np.asarray(engine.master["head"]),
+                               1.0 - 0.01, rtol=1e-6)
+    # explicit argument wins over the JSON spelling
+    engine, opt, _ = make_engine(
+        param_groups=[{"params": "head", "lr": 0.5}],
+        optimizer={"type": "SGD", "params": {"lr": 0.1},
+                   "param_groups": [{"params": "head", "lr": 0.01}]})
+    assert opt.param_groups[1]["lr"] == 0.5
+    with pytest.raises(DeepSpeedConfigError, match="list of group"):
+        make_engine(optimizer={"type": "SGD", "params": {"lr": 0.1},
+                               "param_groups": {"params": "head"}})
+
+
 def test_group_assignment_first_match_wins():
     engine, opt, _ = make_engine(
         param_groups=[{"params": "head|body", "lr": 0.05},
