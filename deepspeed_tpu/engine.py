@@ -1573,6 +1573,45 @@ class DeepSpeedTpuEngine:
         if mem:
             logger.info("memory: %s", mem)
 
+    def memory_estimate(self) -> dict:
+        """Per-device BYTE estimate of persistent engine state — the
+        programmatic twin of the measured envelope
+        (tests/test_zero_memory.py; docs/features.md table).  Modern
+        DeepSpeed's ZeRO memory-estimator analog, exact for this engine:
+
+          params           compute-dtype copy, replicated over data
+          optimizer_state  fp32 master + moments; /min(dp, pps) under
+                           ZeRO, full-size otherwise
+          grad_accumulator fp32; the ZeRO-2 partition, or a full tree
+                           (only held between backward() and step() on
+                           the split API / inside the fused scan)
+        """
+        cdt_bytes = jnp.dtype(self.policy.compute_dtype).itemsize
+        n_params = sum(int(l.size)
+                       for l in jax.tree_util.tree_leaves(self.params))
+        # per-device parameter elements: model/pipe-sharded dims divide
+        # (total is padding-independent, so the dp argument is moot)
+        local_params = zero_mod.make_local_flat_meta(
+            self.params, self._param_specs, dict(self.mesh.shape), 1).total
+        moments = ((self.opt_state.m is not None)
+                   + (self.opt_state.v is not None))
+        if self.zero_enabled:
+            opt_state = 4 * (1 + moments) * self.flat_meta.padded \
+                // self.zero_pps
+            acc = (4 * self.flat_meta.padded // self.zero_pps
+                   if self.zero_stage >= 2 else 4 * local_params)
+        else:
+            opt_state = 4 * (1 + moments) * local_params
+            acc = 4 * local_params
+        return {
+            "params_bytes": cdt_bytes * local_params,
+            "optimizer_state_bytes": opt_state,
+            "grad_accumulator_bytes": acc,
+            "total_persistent_bytes": cdt_bytes * local_params + opt_state,
+            "n_params": n_params,
+            "zero_stage": self.zero_stage,
+        }
+
     # ------------------------------------------------------------- profiling
 
     def start_profile(self, output_path: Optional[str] = None):
