@@ -21,7 +21,9 @@ auto-detected from the device kind when unset), BENCH_SWEEP=1 for a
 batch x remat sweep (rows on stderr, best on stdout), BENCH_OUT=<path> to
 also write the JSON line to a file (committed sweep artifacts),
 BENCH_PP_SWEEP=1 with BENCH_PP_SCHEDULES=gpipe,1f1b for the pipeline
-schedule sweep, BENCH_ATTN_SWEEP=1 for the attention-kernel sweep.
+schedule sweep, BENCH_ATTN_SWEEP=1 for the attention-kernel sweep,
+BENCH_DEVICE_TIMEOUT (default 600 s; <= 0 disables) to fail crisply
+instead of hanging when the device tunnel is wedged.
 
 Calibration note (v5e, measured): the published 197 bf16 TFLOP/s peak is
 reachable only at large contraction dims (K >= 4096).  BERT-large's body
@@ -338,7 +340,40 @@ def run_attention_sweep(steps=10, warmup=3):
 
 
 def main():
+    # A wedged device tunnel makes the first jax.devices() hang FOREVER
+    # (observed failure mode: the axon relay listener disappears and every
+    # client blocks in make_c_api_client).  Fail crisply instead: a
+    # watchdog emits a diagnosable JSON line and exits nonzero when the
+    # backend doesn't come up within BENCH_DEVICE_TIMEOUT seconds.
+    import threading
+
+    backend_up = threading.Event()
+    try:
+        budget = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "600"))
+    except ValueError:
+        raise SystemExit(
+            f"BENCH_DEVICE_TIMEOUT={os.environ['BENCH_DEVICE_TIMEOUT']!r} "
+            "is not a number of seconds (<= 0 disables the watchdog)")
+
+    def watchdog():
+        if not backend_up.wait(timeout=budget):
+            # stdout only — NEVER through _emit/BENCH_OUT, which would
+            # overwrite a previously committed artifact with the error
+            print(json.dumps(
+                {"metric": "bench_error",
+                 "error": f"jax backend init exceeded {budget:.0f}s "
+                          "(device tunnel unreachable/wedged?)"}))
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(3)
+
+    if budget > 0:
+        threading.Thread(target=watchdog, daemon=True).start()
+
     import jax
+
+    jax.devices()
+    backend_up.set()
 
     if os.environ.get("BENCH_PP_SWEEP", "0") == "1":
         return run_pipeline_sweep(
