@@ -81,6 +81,11 @@ class _RestrictedUnpickler(pickle.Unpickler):
         if module == "numpy.dtypes" or module == "numpy.core.numerictypes" \
                 or module == "numpy._core.numerictypes":
             return super().find_class(module, name)   # dtype classes only
+        if module == "ml_dtypes" and not name.startswith("_"):
+            # bf16/fp8 numpy scalar types: a bf16 params array pickles a
+            # reference to ml_dtypes.bfloat16; the module exposes only
+            # dtype classes, so resolving it is as safe as numpy.dtypes
+            return super().find_class(module, name)
         if name in self._SAFE.get(module, ()):
             return super().find_class(module, name)
         if module == "numpy" and not name.startswith("_"):
@@ -252,6 +257,39 @@ def _collect_mp_states(tree, specs, mp_size: int):
     return _collect_shard_states(tree, specs, [(MODEL_AXIS, mp_size)])
 
 
+def _collect_composite_full(tree, specs, axes):
+    """ZeRO-3 collector: materialise each (data-sharded) global leaf fully
+    on host, then slice per composite (pipe, model) rank — so the written
+    files carry data-FULL, composite-local leaves, i.e. exactly the
+    stage-<=2 model-state format.  Restores therefore work under ANY
+    topology/stage (the data partitioning re-materialises from the
+    engine's shardings at device_put).  Single-controller only: the full
+    np.asarray needs every shard addressable (save_checkpoint guards)."""
+    sizes = [n for _, n in axes]
+    S = 1
+    for n in sizes:
+        S *= n
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    spec_leaves = treedef.flatten_up_to(specs)
+    per_rank = [[] for _ in range(S)]
+    for leaf, spec in zip(leaves, spec_leaves):
+        full = np.asarray(leaf)
+        dims = [_axis_dim(spec, name) for name, _ in axes]
+        for r in range(S):
+            rem, comps = r, []
+            for n in reversed(sizes):
+                rem, c = divmod(rem, n)
+                comps.insert(0, c)
+            sl = [slice(None)] * full.ndim
+            for k, d in enumerate(dims):
+                if d is not None:
+                    local = full.shape[d] // sizes[k]
+                    sl[d] = slice(comps[k] * local, (comps[k] + 1) * local)
+            per_rank[r].append(full[tuple(sl)])
+    owned = [jax.process_index() == 0] * S
+    return [treedef.unflatten(vals) for vals in per_rank], owned
+
+
 # ------------------------------------------------------------------- saving
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
@@ -264,6 +302,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     mp = engine.mp_world_size
     pp = getattr(engine, "pp_world_size", 1)
     axes = _state_axes(pp, mp)
+    zero_flat = getattr(engine, "zero_flat", engine.zero_enabled)
+    zero3 = getattr(engine, "zero3", False)
+    if zero3 and jax.process_count() > 1:
+        raise NotImplementedError(
+            "ZeRO-3 checkpoint save reassembles data-sharded leaves on the "
+            "host, which needs every shard addressable — multi-host stage-3 "
+            "saves are not supported yet (stages 1-2 are)")
     scalar_state = {
         "loss_scale_state": _to_np(engine.loss_scale_state._asdict()),
         "loss_scale_variant": engine._ls_variant,
@@ -278,32 +323,37 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "skipped_steps": engine.skipped_steps,
         "micro_steps": engine.micro_steps,
         "zero_enabled": engine.zero_enabled,
+        "zero_stage": getattr(engine, "zero_stage",
+                              1 if engine.zero_enabled else 0),
         "mp_world_size": mp,
         "pp_world_size": pp,
         "client_state": dict(client_state or {}),
     }
 
     S = pp * mp
-    params_s, owned = _collect_shard_states(engine.params,
-                                            engine._param_specs, axes,
-                                            mesh=engine.mesh)
-    if engine.zero_enabled:
+    if zero3:
+        # data-sharded leaves: reassemble full-along-data on the host so
+        # the files match the stage-<=2 format (restorable anywhere)
+        collect = lambda t: _collect_composite_full(t, engine._param_specs,
+                                                    axes)
+    else:
+        collect = lambda t: _collect_shard_states(t, engine._param_specs,
+                                                  axes, mesh=engine.mesh)
+    params_s, owned = collect(engine.params)
+    if zero_flat:
         # three SEPARATE lists: masters live in ZeRO files, and sharing one
         # list object would make any future in-place write corrupt all three
         master_s, m_s, v_s = ([None] * S for _ in range(3))
         step_np = None
     else:
-        master_s, _ = _collect_shard_states(engine.master,
-                                            engine._param_specs, axes,
-                                            mesh=engine.mesh)
+        # replicated masters — or ZeRO-3's per-leaf data-sharded masters,
+        # saved inline in the model-state files (stage 3 writes no
+        # zero_pp_rank_* partition shards)
+        master_s, _ = collect(engine.master)
         m_s = ([None] * S if engine.opt_state.m is None else
-               _collect_shard_states(engine.opt_state.m,
-                                     engine._param_specs, axes,
-                                     mesh=engine.mesh)[0])
+               collect(engine.opt_state.m)[0])
         v_s = ([None] * S if engine.opt_state.v is None else
-               _collect_shard_states(engine.opt_state.v,
-                                     engine._param_specs, axes,
-                                     mesh=engine.mesh)[0])
+               collect(engine.opt_state.v)[0])
         step_np = np.asarray(engine.opt_state.step)
 
     for rank in range(S):
@@ -314,7 +364,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         state["mp_rank"] = mp_rank
         state["pp_stage"] = stage
         state["module"] = params_s[rank]
-        if engine.zero_enabled:
+        if zero_flat:
             state["optimizer"] = None
         else:
             state["optimizer"] = {
@@ -537,15 +587,23 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.lr_scheduler.load_state_dict(state["lr_scheduler"])
 
     restored_masters = False
+    saved_stage = state.get("zero_stage",
+                            1 if state.get("zero_enabled") else 0)
     if load_optimizer_states:
-        if engine.zero_enabled:
+        if getattr(engine, "zero_flat", engine.zero_enabled):
+            if saved_stage == 3:
+                raise ValueError(
+                    "checkpoint was saved at ZeRO stage 3 (optimizer state "
+                    "inline, per-leaf) but this engine runs the stage-1/2 "
+                    "flat layout — set zero_optimization.stage=3 (or 0) to "
+                    "restore it, or pass load_optimizer_states=False")
             _load_zero_checkpoint(engine, load_dir, tag)
             restored_masters = True
-        elif state.get("zero_enabled"):
+        elif saved_stage in (1, 2):
             raise ValueError(
-                "checkpoint was saved with zero_optimization enabled (its "
-                "optimizer state lives in zero_pp_rank_* shards) but this "
-                "engine has ZeRO off — enable zero_optimization, or pass "
+                "checkpoint was saved with ZeRO stage 1/2 (its optimizer "
+                "state lives in zero_pp_rank_* shards) but this engine "
+                "runs no flat ZeRO layout — match the stage, or pass "
                 "load_optimizer_states=False for a weights-only load")
         elif state.get("optimizer") is not None:
             master = _combine_shard_states(
@@ -584,9 +642,10 @@ def _rederive_masters(engine) -> None:
     """Rebuild fp32 masters (flat or per-leaf) from engine.params."""
     masters = jax.tree_util.tree_map(
         lambda p: jnp.asarray(p, jnp.float32), engine.params)
-    if engine.zero_enabled and engine._zero_state_axes:
+    zero_flat = getattr(engine, "zero_flat", engine.zero_enabled)
+    if zero_flat and engine._zero_state_axes:
         engine.master_flat = engine._flatten_masters_2d(masters)
-    elif engine.zero_enabled:
+    elif zero_flat:
         flat = engine._tile_flat(
             zero_mod.flatten_tree(masters, engine.flat_meta))
         engine.master_flat = jax.device_put(flat,

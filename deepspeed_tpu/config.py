@@ -130,10 +130,11 @@ class DeepSpeedConfig:
         zero = get_scalar_param(pd, C.ZERO_OPTIMIZATION, C.ZERO_OPTIMIZATION_DEFAULT)
         if isinstance(zero, Mapping):
             self.zero_stage = int(zero.get("stage", 0))
-            if self.zero_stage not in (0, 1, 2):
+            if self.zero_stage not in (0, 1, 2, 3):
                 raise DeepSpeedConfigError(
-                    f"zero_optimization.stage must be 0, 1 or 2 "
-                    f"(2 = gradient partitioning), got {self.zero_stage}")
+                    f"zero_optimization.stage must be 0-3 (2 = gradient "
+                    f"partitioning, 3 = parameter partitioning), got "
+                    f"{self.zero_stage}")
             self.zero_enabled = self.zero_stage > 0
             self.zero_parameter_parallel_size = zero.get(
                 C.ZERO_PARAMETER_PARALLEL_SIZE, C.ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT)

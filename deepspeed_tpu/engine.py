@@ -44,6 +44,7 @@ from deepspeed_tpu import constants as C
 from deepspeed_tpu import lr_schedules as schedules_mod
 from deepspeed_tpu import precision as prec
 from deepspeed_tpu import zero as zero_mod
+from deepspeed_tpu import zero3 as zero3_mod
 from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.data import DeepSpeedDataLoader
 from deepspeed_tpu.ops import optim as optim_mod
@@ -484,6 +485,31 @@ class DeepSpeedTpuEngine:
         # owned flat partition INSIDE the accumulation loop, so the
         # grad-accumulation buffer shrinks from full-size to 1/pps
         self.zero_stage = self.config.zero_stage if self.zero_enabled else 0
+        # stage 3 = parameter partitioning (zero3.py): params/masters/
+        # moments persist per-leaf data-sharded, the model gathers each
+        # layer's weights on use, and the gather's autodiff transpose
+        # reduce-scatters the grads.  Stages 1-2 keep the flat-buffer
+        # layout; ``zero_flat`` gates every flat-layout code path.
+        self.zero3 = self.zero_stage == 3
+        self.zero_flat = self.zero_enabled and not self.zero3
+        if self.zero3:
+            if not hasattr(model, "zero3_dims"):
+                raise DeepSpeedConfigError(
+                    "zero_optimization.stage=3 requires a model that "
+                    "cooperates with parameter partitioning (a zero3_dims "
+                    "attribute the engine fills and a per-layer gather in "
+                    "the block scan — the built-in GPT-2/BERT/MoE family "
+                    "does; see models/transformer.py zero3_enter)")
+            if self.zero_pps != self.dp_world_size:
+                raise DeepSpeedConfigError(
+                    "zero_optimization.parameter_parallel_size is a "
+                    "stage-1/2 flat-layout knob; stage 3 partitions over "
+                    "the full DP group")
+            if self.pp_world_size > 1:
+                raise DeepSpeedConfigError(
+                    "zero_optimization.stage=3 x pipeline parallelism is "
+                    "not composed yet: the pipeline stack already shards "
+                    "layers over 'pipe' (use stage 2, which composes)")
 
         # -- loss scale state
         if self.config.fp16_enabled:
@@ -517,6 +543,28 @@ class DeepSpeedTpuEngine:
         self._param_specs = self._resolve_param_specs(model, model_parameters)
         self._sparse_flags = self._resolve_sparse_flags(model,
                                                         model_parameters)
+        self._zero3_dims = None
+        if self.zero3:
+            min_fn = getattr(model, "zero3_min_dims", None)
+            self._zero3_dims = zero3_mod.choose_dims(
+                model_parameters, self._param_specs, dict(self.mesh.shape),
+                self.dp_world_size,
+                min_dims=min_fn(model_parameters) if min_fn else None)
+            if not zero3_mod.partitioned_any(self._zero3_dims):
+                logger.warning(
+                    "zero_optimization.stage=3: no parameter leaf is "
+                    "partitionable at dp=%d (divisibility/min-size); "
+                    "training proceeds with replicated parameters "
+                    "(stage-1-like memory)", self.dp_world_size)
+            self._param_specs = zero3_mod.augment_specs(self._param_specs,
+                                                        self._zero3_dims)
+            # hand the dims to the model on a SHALLOW COPY: examples and
+            # tests reuse one model object across several engines, and a
+            # stage-0 engine tracing a shared instance with zero3_dims set
+            # would gather unpartitioned leaves dp-fold
+            import copy
+            model = self.module = copy.copy(model)
+            model.zero3_dims = self._zero3_dims
         if param_groups is None and self.client_optimizer is None:
             # pure-JSON spelling (optimizer.param_groups); the explicit
             # initialize(param_groups=...) argument beats it, and a
@@ -552,9 +600,12 @@ class DeepSpeedTpuEngine:
         self.optimizer = OptimizerFacade(self)
         self._configure_lr_scheduler()
 
-        # -- checkpoint roles (reference _configure_checkpointing :329-343)
+        # -- checkpoint roles (reference _configure_checkpointing :329-343).
+        # Stage 3 saves masters/moments in the per-leaf (non-flat) format —
+        # no zero_pp_rank_* partition files (checkpoint.py routes on
+        # zero_flat).
         self.save_non_zero_checkpoint = jax.process_index() == 0
-        self.save_zero_checkpoint = self.zero_enabled
+        self.save_zero_checkpoint = self.zero_flat
 
         # -- tensorboard (reference :106-120)
         self.summary_writer = (self._get_summary_writer()
@@ -681,7 +732,7 @@ class DeepSpeedTpuEngine:
         to_f32 = lambda x: jnp.asarray(x, jnp.float32)
         masters = jax.tree_util.tree_map(to_f32, model_parameters)
 
-        if self.zero_enabled and self._zero_state_axes:
+        if self.zero_flat and self._zero_state_axes:
             # ZeRO x MP/PP: each (pipe stage, model rank) keeps a flat fp32
             # master of only ITS parameter slices, partitioned over its DP
             # group (reference parameter-parallel groups,
@@ -701,7 +752,7 @@ class DeepSpeedTpuEngine:
                     self.flat_meta, self._param_specs,
                     self._zero_state_axes))),
                 self._named(P(DATA_AXIS)))
-        elif self.zero_enabled:
+        elif self.zero_flat:
             # partitions align to zero_pps (== dp unless
             # parameter_parallel_size shrinks the partition group); with
             # sub-groups the flat buffer is tiled repl× so each consecutive
@@ -713,6 +764,9 @@ class DeepSpeedTpuEngine:
             self.master = None
             self._zero_norm_w = None
         else:
+            # replicated masters — or, at ZeRO-3, per-leaf DATA-sharded
+            # masters: self._param_specs is already augmented with the
+            # partition dims, so the same placement code shards them
             self.flat_meta = None
             self.master_flat = None
             self.master = jax.tree_util.tree_map(
@@ -725,7 +779,7 @@ class DeepSpeedTpuEngine:
             self._zero_norm_w = jax.device_put(
                 jnp.zeros((self.dp_world_size,), jnp.float32),
                 self._named(P(DATA_AXIS)))
-        if self.zero_enabled and len(self._group_defs) > 1:
+        if self.zero_flat and len(self._group_defs) > 1:
             # per-element group ids over the flat layout: hypers expand as
             # vec[gid] inside the partitioned update.  meta.sizes are the
             # LOCAL slice sizes under MP/PP (identical for every
@@ -819,7 +873,7 @@ class DeepSpeedTpuEngine:
 
     def _init_optimizer_state(self):
         opt = self.base_optimizer
-        if self.zero_enabled:
+        if self.zero_flat:
             # moments over the flat partition-sharded master
             flat_spec = self._zero_flat_spec()
             st = opt.init({"flat": self.master_flat})
@@ -987,6 +1041,29 @@ class DeepSpeedTpuEngine:
     def _grad_stack_specs(self):
         return jax.tree_util.tree_map(lambda s: P(DATA_AXIS, *s),
                                       self._param_specs)
+
+    # ------------------------------------------------- ZeRO-3 grad plumbing
+    # Split-API grads cross the shard_map boundary between micro-steps.  A
+    # partitioned leaf's grad is already a true global slice (reduced +
+    # scattered by the gather transpose) — its out-spec IS the param spec.
+    # A replicated leaf's grad is a per-shard partial, represented as a
+    # [dp, ...] stack exactly like the non-ZeRO path.
+
+    def _z3_pack(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g, d: (None if g is None else (g if d >= 0 else g[None])),
+            grads, self._zero3_dims, is_leaf=lambda x: x is None)
+
+    def _z3_unpack(self, acc):
+        return jax.tree_util.tree_map(
+            lambda g, d: (None if g is None else (g if d >= 0 else g[0])),
+            acc, self._zero3_dims, is_leaf=lambda x: x is None)
+
+    def _z3_grad_specs(self):
+        return jax.tree_util.tree_map(
+            lambda s, d: s if d >= 0 else P(DATA_AXIS, *s),
+            self._param_specs, self._zero3_dims,
+            is_leaf=lambda x: isinstance(x, P))
 
     @staticmethod
     def _spec_axes(spec) -> set:
@@ -1158,13 +1235,16 @@ class DeepSpeedTpuEngine:
 
     def _build_fwdbwd(self, batch):
         loss_and_grads = self._make_loss_and_grads()
-        stage2 = self.zero_stage >= 2
+        stage2 = self.zero_stage == 2
+        zero3 = self.zero3
 
         def local(params, ls_scale, batch_args):
             loss_out, grads = loss_and_grads(params, ls_scale, batch_args)
             if stage2:
                 return loss_out, self._scatter_grads_local(
                     grads, across_subgroups=False)
+            if zero3:
+                return loss_out, self._z3_pack(grads)
             return loss_out, jax.tree_util.tree_map(
                 lambda g: g[None], grads)
 
@@ -1172,6 +1252,7 @@ class DeepSpeedTpuEngine:
             local, mesh=self.mesh,
             in_specs=(self._param_specs, P(), self._batch_specs(batch)),
             out_specs=(P(), self._zero_flat_spec() if stage2
+                       else self._z3_grad_specs() if zero3
                        else self._grad_stack_specs()),
             check_vma=False)
         return jax.jit(fn)
@@ -1328,8 +1409,12 @@ class DeepSpeedTpuEngine:
         fp16 = cfg.fp16_enabled
         clip = self.clip_grad
         variant = self._ls_variant
-        zero = self.zero_enabled
-        stage2 = self.zero_stage >= 2
+        zero = self.zero_flat
+        zero3 = self.zero3
+        z3_dims = self._zero3_dims
+        param_specs = self._param_specs
+        axis_sizes = dict(self.mesh.shape)
+        stage2 = self.zero_stage == 2
         mp = self.mp_world_size
         state_axes = list(self._zero_state_axes)
         zero_2d = zero and bool(state_axes)
@@ -1438,6 +1523,64 @@ class DeepSpeedTpuEngine:
                         m=jax.tree_util.tree_map(lambda x: x[None], new_opt.m),
                         v=(jax.tree_util.tree_map(lambda x: x[None], new_opt.v)
                            if new_opt.v is not None else None))
+            elif zero3:
+                # ZeRO-3 (zero3.py): partitioned leaves arrive REDUCED and
+                # SCATTERED (the layer gather's autodiff transpose is a
+                # tiled psum_scatter over 'data') — finish their averaging
+                # with 1/world; replicated leaves are plain local grads and
+                # psum with the full knob semantics
+                knobs = dict(
+                    fp32_allreduce=cfg.fp32_allreduce,
+                    prescale_gradients=cfg.prescale_gradients,
+                    gradient_predivide_factor=cfg.gradient_predivide_factor)
+
+                def reduce_leaf(g, d):
+                    if g is None:
+                        return None
+                    if d >= 0:
+                        return g / world
+                    return comm.allreduce_grads(g, DATA_AXIS, world, **knobs)
+
+                grads = jax.tree_util.tree_map(
+                    reduce_leaf, grads, z3_dims,
+                    is_leaf=lambda x: x is None)
+                # norm/overflow: partitioned shards are disjoint over DP
+                # (weight 1, psum over data); replicated leaves identical
+                # over DP (1/dp); model/pipe dedup per the leaf spec —
+                # every shard takes the same skip/clip decision (reference
+                # deepspeed_utils.py:62-75, 100-158)
+                sq, finite = zero3_mod.local_sqnorm_and_finite(
+                    grads, z3_dims, param_specs, axis_sizes)
+                overflow = comm.overflow_any(jnp.logical_not(finite),
+                                             DATA_AXIS)
+                sq = jax.lax.psum(sq, DATA_AXIS)
+                for ax, _ in state_axes:
+                    overflow = comm.overflow_any(overflow, ax)
+                    sq = jax.lax.psum(sq, ax)
+                total_norm = jnp.sqrt(sq)
+                combined = prec.combined_unscale_and_clip_factor(
+                    total_norm, ls_state, clip) if fp16 else (
+                    prec.combined_unscale_and_clip_factor(
+                        total_norm, prec.static_loss_scale_state(1.0), clip)
+                    if clip > 0 else 1.0)
+                # elementwise Adam-family update directly on the local
+                # (master, moment, grad) shards — the partitioning is
+                # invisible to the optimizer
+                new_master, new_opt = opt.update(
+                    master, grads, opt_state,
+                    lr=lr, beta1=b1, beta2=b2, weight_decay=wd,
+                    combined_scale=combined)
+                if fp16:
+                    new_master = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(overflow, old, new),
+                        new_master, master)
+                    new_opt = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(overflow, old, new),
+                        new_opt, opt_state)
+                # NO weight all-gather: params persist partitioned; the
+                # next step's layer gathers re-materialise them on use
+                params = jax.tree_util.tree_map(
+                    lambda m: m.astype(cdt), new_master)
             else:
                 knobs = dict(
                     fp32_allreduce=cfg.fp32_allreduce,
@@ -1506,8 +1649,10 @@ class DeepSpeedTpuEngine:
         return P(DATA_AXIS)
 
     def _step_specs(self):
-        """(master_spec, opt_spec, ls_spec) partition specs for the update."""
-        zero = self.zero_enabled
+        """(master_spec, opt_spec, ls_spec) partition specs for the update.
+        At ZeRO-3 the per-leaf ``_param_specs`` (data-augmented) serve as
+        the master/moment specs — the non-flat ``else`` arms below."""
+        zero = self.zero_flat
         if zero:
             flat_spec = self._zero_flat_spec()
         master_spec = (flat_spec if zero else self._param_specs)
@@ -1522,13 +1667,18 @@ class DeepSpeedTpuEngine:
 
     def _build_step(self):
         step_local = self._make_step_local()
-        stage2 = self.zero_stage >= 2
+        stage2 = self.zero_stage == 2
+        zero3 = self.zero3
 
         def local(master, opt_state, acc, ls_state, lr, b1, b2, wd, normw,
                   gids):
             if stage2:
                 # acc IS the accumulated flat partition (ZeRO-2)
                 grads = acc
+            elif zero3:
+                # partitioned leaves arrive as true local slices,
+                # replicated leaves as [1, ...] per-shard stacks
+                grads = self._z3_unpack(acc)
             else:
                 # acc leaves arrive as [1, ...] local slices
                 grads = jax.tree_util.tree_map(lambda g: g[0], acc)
@@ -1540,6 +1690,7 @@ class DeepSpeedTpuEngine:
             local, mesh=self.mesh,
             in_specs=(master_spec, opt_spec,
                       self._zero_flat_spec() if stage2
+                      else self._z3_grad_specs() if zero3
                       else self._grad_stack_specs(),
                       ls_spec, P(), P(), P(), P(), P(DATA_AXIS),
                       P(DATA_AXIS)),
@@ -1589,18 +1740,23 @@ class DeepSpeedTpuEngine:
         cdt_bytes = jnp.dtype(self.policy.compute_dtype).itemsize
         n_params = sum(int(l.size)
                        for l in jax.tree_util.tree_leaves(self.params))
-        # per-device parameter elements: model/pipe-sharded dims divide
-        # (total is padding-independent, so the dp argument is moot)
+        # per-device parameter elements: every sharded dim divides — under
+        # ZeRO-3 self._param_specs include the data axis, so this IS the
+        # 1/dp partitioned count (total is padding-independent, so the dp
+        # argument is moot)
         local_params = zero_mod.make_local_flat_meta(
             self.params, self._param_specs, dict(self.mesh.shape), 1).total
         moments = ((self.opt_state.m is not None)
                    + (self.opt_state.v is not None))
-        if self.zero_enabled:
+        if self.zero_flat:
             opt_state = 4 * (1 + moments) * self.flat_meta.padded \
                 // self.zero_pps
             acc = (4 * self.flat_meta.padded // self.zero_pps
                    if self.zero_stage >= 2 else 4 * local_params)
         else:
+            # replicated — or ZeRO-3, where local_params already carries
+            # the data-axis division for params, masters, moments AND the
+            # grad accumulator alike
             opt_state = 4 * (1 + moments) * local_params
             acc = 4 * local_params
         return {
@@ -1704,13 +1860,13 @@ class DeepSpeedTpuEngine:
             self._force_live_pendings()  # about to mutate params
             if self._step_fn is None:
                 self._step_fn = self._build_step()
-            master = self.master_flat if self.zero_enabled else self.master
+            master = self.master_flat if self.zero_flat else self.master
             lr, b1, b2, wd = self._current_hypers()
             (self.params, new_master, self.opt_state, self.loss_scale_state,
              overflow, self._last_grad_norm) = self._step_fn(
                 master, self.opt_state, self._acc, self.loss_scale_state,
                 lr, b1, b2, wd, self._zero_norm_w, self._zero_gid_flat)
-            if self.zero_enabled:
+            if self.zero_flat:
                 self.master_flat = new_master
             else:
                 self.master = new_master
@@ -1748,7 +1904,10 @@ class DeepSpeedTpuEngine:
         gas = self.gradient_accumulation_steps()
         loss_and_grads = self._make_loss_and_grads()
         step_local = self._make_step_local()
-        stage2 = self.zero_stage >= 2
+        stage2 = self.zero_stage == 2
+        # (ZeRO-3 needs no special casing here: grads/acc live on local
+        # shard shapes — partitioned leaves are already scattered by the
+        # gather transpose — and step_local consumes them in place)
 
         def local(params, master, opt_state, ls_state, lr, b1, b2, wd,
                   normw, gids, batch_args):
@@ -1839,14 +1998,14 @@ class DeepSpeedTpuEngine:
                 f"gradient_accumulation_steps={gas}")
         if self._train_batch_fn is None:
             self._train_batch_fn = self._build_train_batch(batch)
-        master = self.master_flat if self.zero_enabled else self.master
+        master = self.master_flat if self.zero_flat else self.master
         lr, b1, b2, wd = self._current_hypers()
         (self.params, new_master, self.opt_state, self.loss_scale_state,
          overflow, self._last_grad_norm, loss) = self._train_batch_fn(
             self.params, master, self.opt_state, self.loss_scale_state,
             lr, b1, b2, wd, self._zero_norm_w, self._zero_gid_flat,
             batch)
-        if self.zero_enabled:
+        if self.zero_flat:
             self.master_flat = new_master
         else:
             self.master = new_master
@@ -1895,8 +2054,9 @@ class DeepSpeedTpuEngine:
             "opt_state": self.opt_state,
             "loss_scale_state": self.loss_scale_state,
             "zero_enabled": self.zero_enabled,
+            "zero_stage": self.zero_stage,
         }
-        if self.zero_enabled:
+        if self.zero_flat:
             sd["master_flat"] = self.master_flat
         else:
             sd["master"] = self.master
@@ -1910,7 +2070,7 @@ class DeepSpeedTpuEngine:
         self.loss_scale_state = jax.tree_util.tree_map(
             lambda old, new: jax.device_put(jnp.asarray(new), old.sharding),
             self.loss_scale_state, sd["loss_scale_state"])
-        if self.zero_enabled:
+        if self.zero_flat:
             self.master_flat = jax.device_put(
                 jnp.asarray(sd["master_flat"]), self.master_flat.sharding)
             self.params = self._params_from_master_flat()

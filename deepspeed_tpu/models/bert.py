@@ -58,15 +58,26 @@ def _backbone_partition_specs() -> dict:
     }
 
 
-def _encode(cfg, params, input_ids, attention_mask, token_type_ids):
-    """Embed + encoder stack (runs inside shard_map on local shards)."""
+def _encode(cfg, params, input_ids, attention_mask, token_type_ids,
+            z3_block_dims=None):
+    """Embed + encoder stack (runs inside shard_map on local shards).
+    Callers must already have run ``T.zero3_enter`` on ``params`` under
+    ZeRO-3 (``z3_block_dims`` = its deferred block dims)."""
     T_len = input_ids.shape[1]
     x = L.vocab_parallel_embedding(input_ids, params["wte"])
     x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
         x.dtype)[None]
     x = x + jnp.take(params["wtt"].astype(x.dtype), token_type_ids, axis=0)
     x = L.layer_norm(x, params["ln_emb_s"], params["ln_emb_b"], cfg.ln_eps)
-    return T.stack_apply(x, params["blocks"], cfg, attn_mask=attention_mask)
+    return T.stack_apply(x, params["blocks"], cfg, attn_mask=attention_mask,
+                         z3_dims=z3_block_dims)
+
+
+def _zero3_min_dims(params):
+    """Stage-3 hook body shared by both BERT heads (see GPT2)."""
+    md = jax.tree_util.tree_map(lambda _: 0, params)
+    md["blocks"] = jax.tree_util.tree_map(lambda _: 1, md["blocks"])
+    return md
 
 
 @dataclasses.dataclass
@@ -78,6 +89,8 @@ class BertForPreTraining:
     """
     config: T.TransformerConfig
     use_nsp: bool = False
+    #: ZeRO-3 partition dims (set by the engine at stage 3; zero3.py)
+    zero3_dims: object = None
 
     @classmethod
     def from_size(cls, size: str, use_nsp: bool = False, **overrides):
@@ -146,6 +159,10 @@ class BertForPreTraining:
             specs.append(P(DATA_AXIS))             # nsp_labels [B]
         return tuple(specs)
 
+    def zero3_min_dims(self, params):
+        """Engine hook (stage 3): block leaves pin dim >= 1 (layer stack)."""
+        return _zero3_min_dims(params)
+
     def _mlm_head(self, params, h):
         """Dense + LN + tied vocab decoder on [.., H] hidden states."""
         cfg = self.config
@@ -187,7 +204,9 @@ class BertForPreTraining:
                 f"mlm_positions, mlm_ids, mlm_weights[, nsp], got "
                 f"{len(rest)} trailing args")
 
-        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
+        params, z3_deferred = T.zero3_enter(params, self.zero3_dims)
+        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids,
+                    z3_block_dims=z3_deferred.get("blocks"))
 
         if mlm_positions is None:
             logits = self._mlm_head(params, x)
@@ -229,6 +248,8 @@ class BertForQuestionAnswering:
     end_positions) → scalar loss.
     """
     config: T.TransformerConfig
+    #: ZeRO-3 partition dims (set by the engine at stage 3; zero3.py)
+    zero3_dims: object = None
 
     @classmethod
     def from_size(cls, size: str, **overrides):
@@ -263,6 +284,10 @@ class BertForQuestionAnswering:
         seq = P(DATA_AXIS, SEQ_AXIS)
         return (seq, seq, seq, P(DATA_AXIS), P(DATA_AXIS))
 
+    def zero3_min_dims(self, params):
+        """Engine hook (stage 3): block leaves pin dim >= 1 (layer stack)."""
+        return _zero3_min_dims(params)
+
     def span_logits(self, params, input_ids, attention_mask, token_type_ids):
         """(start_logits, end_logits), each [B, T] fp32 — the prediction
         path for EM/F1 evaluation (metrics.best_spans)."""
@@ -272,7 +297,9 @@ class BertForQuestionAnswering:
                 "indexes global positions — not supported under "
                 "context_parallel_size > 1 (fine-tune lengths don't need it)")
         cfg = self.config
-        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids)
+        params, z3_deferred = T.zero3_enter(params, self.zero3_dims)
+        x = _encode(cfg, params, input_ids, attention_mask, token_type_ids,
+                    z3_block_dims=z3_deferred.get("blocks"))
         logits = (x @ params["qa_w"].astype(x.dtype)
                   + params["qa_b"].astype(x.dtype)).astype(jnp.float32)
         return logits[..., 0], logits[..., 1]

@@ -47,6 +47,10 @@ GPT2_SIZES = {
 class GPT2:
     """Callable model object satisfying the engine protocol."""
     config: T.TransformerConfig
+    #: ZeRO-3 partition dims (set by the engine at stage 3; zero3.py).
+    #: The block subtree is gathered per layer inside the scan, the rest
+    #: at apply entry (transformer.zero3_enter).
+    zero3_dims: object = None
 
     @classmethod
     def from_size(cls, size: str, **overrides) -> "GPT2":
@@ -98,11 +102,19 @@ class GPT2:
         sequence, so it shards over the context-parallel ring."""
         return T.token_batch_specs(batch)
 
+    def zero3_min_dims(self, params):
+        """Engine hook (stage 3): lowest partitionable dim per leaf.  Block
+        leaves pin dim >= 1 — their dim 0 is the layer stack the scan
+        consumes, which must stay whole on every shard."""
+        md = jax.tree_util.tree_map(lambda _: 0, params)
+        md["blocks"] = jax.tree_util.tree_map(lambda _: 1, md["blocks"])
+        return md
+
     # --------------------------------------------------------------- forward
-    def _stack(self, x, blocks):
+    def _stack(self, x, blocks, z3_dims=None):
         """Block-stack hook: returns (x, auxiliary loss term).  GPT2MoE
         overrides this with the MoE stack + weighted load-balance loss."""
-        return T.stack_apply(x, blocks, self.config), 0.0
+        return T.stack_apply(x, blocks, self.config, z3_dims=z3_dims), 0.0
 
     def apply(self, params, tokens, labels):
         """tokens, labels: int32 [B, T]; labels < 0 are ignored.  Returns the
@@ -110,10 +122,12 @@ class GPT2:
         engine pmean's across data) plus any stack auxiliary loss."""
         cfg = self.config
         T_len = tokens.shape[1]
+        params, z3_deferred = T.zero3_enter(params, self.zero3_dims)
         x = L.vocab_parallel_embedding(tokens, params["wte"])
         x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
             x.dtype)[None]
-        x, aux = self._stack(x, params["blocks"])
+        x, aux = self._stack(x, params["blocks"],
+                             z3_dims=z3_deferred.get("blocks"))
         x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
         logits = L.vocab_parallel_logits(x, params["wte"])
         loss = L.vocab_parallel_cross_entropy(logits, labels)

@@ -41,8 +41,8 @@ class GPT2MoE(GPT2):
     def _block_specs(self):
         return M.moe_block_partition_specs()
 
-    def _stack(self, x, blocks):
-        x, aux = M.moe_stack_apply(x, blocks, self.config)
+    def _stack(self, x, blocks, z3_dims=None):
+        x, aux = M.moe_stack_apply(x, blocks, self.config, z3_dims=z3_dims)
         return x, self.config.aux_weight * aux
 
 

@@ -204,10 +204,15 @@ def moe_block_apply(x, p, cfg: MoEConfig, attn_mask=None):
                                                       valid=attn_mask))
 
 
-def moe_stack_apply(x, stacked_params, cfg: MoEConfig, attn_mask=None):
-    """lax.scan over the stacked [L, ...] MoE blocks; returns (x, aux_sum)."""
+def moe_stack_apply(x, stacked_params, cfg: MoEConfig, attn_mask=None,
+                    z3_dims=None):
+    """lax.scan over the stacked [L, ...] MoE blocks; returns (x, aux_sum).
+    ``z3_dims``: ZeRO-3 partition dims of the stacked leaves (per-layer
+    gather, transformer.zero3_wrap_body)."""
     def body(carry, lp):
         return moe_block_apply(carry, lp, cfg, attn_mask)
 
-    x, auxes = jax.lax.scan(T.remat_wrap(body, cfg), x, stacked_params)
+    x, auxes = jax.lax.scan(
+        T.remat_wrap(T.zero3_wrap_body(body, z3_dims), cfg), x,
+        stacked_params)
     return x, jnp.sum(auxes)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu import zero3 as Z
 from deepspeed_tpu.models import layers as L
 from deepspeed_tpu.parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
@@ -177,9 +178,53 @@ def remat_wrap(body, cfg: TransformerConfig):
         "(expected 'full', 'dots' or 'selective')")
 
 
-def stack_apply(x, stacked_params, cfg: TransformerConfig, attn_mask=None):
-    """Run all layers via lax.scan over the stacked [L, ...] params."""
+def zero3_enter(params, dims, deferred=("blocks",)):
+    """ZeRO-3 entry gather (runs inside shard_map, zero3.py design).
+
+    Gathers every partitioned NON-deferred leaf to its model-local shape
+    now; ``deferred`` subtrees (the block stacks) stay partitioned — their
+    scan body gathers one layer at a time, which is the whole point: peak
+    weight memory is one layer, not the model.  Returns ``(params,
+    deferred_dims)`` where ``deferred_dims[key]`` indexes the STACKED
+    leaves (callers shift by -1 inside the scan).  No-op when ``dims`` is
+    None (stage < 3)."""
+    if dims is None:
+        return params, {}
+    masked = {}
+    deferred_dims = {}
+    for key, sub in dims.items():
+        if key in deferred:
+            deferred_dims[key] = sub
+            masked[key] = jax.tree_util.tree_map(
+                lambda _: Z.REPLICATED, sub)
+        else:
+            masked[key] = sub
+    return Z.gather_tree(params, masked), deferred_dims
+
+
+def zero3_wrap_body(body, z3_dims):
+    """Wrap a scan body so each layer's partitioned weights are gathered
+    right before use (``z3_dims`` indexes the STACKED leaves; the layer
+    axis is already sliced off, hence the -1 shift).  Under remat the
+    gather replays in the backward; its autodiff transpose delivers the
+    grads reduce-scattered."""
+    if z3_dims is None or not Z.partitioned_any(z3_dims):
+        return body
+    body_dims = Z.shift_dims(z3_dims, -1)
+
+    def wrapped(carry, lp):
+        return body(carry, Z.gather_tree(lp, body_dims))
+
+    return wrapped
+
+
+def stack_apply(x, stacked_params, cfg: TransformerConfig, attn_mask=None,
+                z3_dims=None):
+    """Run all layers via lax.scan over the stacked [L, ...] params.
+    ``z3_dims``: ZeRO-3 partition dims of the stacked leaves (gather per
+    layer inside the body — see ``zero3_wrap_body``)."""
     def body(carry, lp):
         return block_apply(carry, lp, cfg, attn_mask), None
-    x, _ = jax.lax.scan(remat_wrap(body, cfg), x, stacked_params)
+    x, _ = jax.lax.scan(
+        remat_wrap(zero3_wrap_body(body, z3_dims), cfg), x, stacked_params)
     return x
