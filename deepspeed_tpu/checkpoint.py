@@ -601,10 +601,11 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             restored_masters = True
         elif saved_stage in (1, 2):
             raise ValueError(
-                "checkpoint was saved with ZeRO stage 1/2 (its optimizer "
-                "state lives in zero_pp_rank_* shards) but this engine "
-                "runs no flat ZeRO layout — match the stage, or pass "
-                "load_optimizer_states=False for a weights-only load")
+                "checkpoint was saved with zero_optimization stage 1/2 "
+                "(its optimizer state lives in zero_pp_rank_* shards) but "
+                "this engine runs no flat ZeRO layout — match the stage, "
+                "or pass load_optimizer_states=False for a weights-only "
+                "load")
         elif state.get("optimizer") is not None:
             master = _combine_shard_states(
                 [s["optimizer"]["master"] for s in states],

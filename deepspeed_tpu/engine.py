@@ -505,11 +505,10 @@ class DeepSpeedTpuEngine:
                     "zero_optimization.parameter_parallel_size is a "
                     "stage-1/2 flat-layout knob; stage 3 partitions over "
                     "the full DP group")
-            if self.pp_world_size > 1:
-                raise DeepSpeedConfigError(
-                    "zero_optimization.stage=3 x pipeline parallelism is "
-                    "not composed yet: the pipeline stack already shards "
-                    "layers over 'pipe' (use stage 2, which composes)")
+            # (pipeline composes: the stage-local [L/pp] stack gathers per
+            # layer exactly like the full stack — dim 0 is pipe-sharded
+            # and zero3_min_dims pins it, so the data axis lands on a
+            # weight dim; tests/test_zero3.py::test_zero3_with_pipeline)
 
         # -- loss scale state
         if self.config.fp16_enabled:
@@ -1413,7 +1412,6 @@ class DeepSpeedTpuEngine:
         zero3 = self.zero3
         z3_dims = self._zero3_dims
         param_specs = self._param_specs
-        axis_sizes = dict(self.mesh.shape)
         stage2 = self.zero_stage == 2
         mp = self.mp_world_size
         state_axes = list(self._zero_state_axes)
@@ -1550,7 +1548,7 @@ class DeepSpeedTpuEngine:
                 # every shard takes the same skip/clip decision (reference
                 # deepspeed_utils.py:62-75, 100-158)
                 sq, finite = zero3_mod.local_sqnorm_and_finite(
-                    grads, z3_dims, param_specs, axis_sizes)
+                    grads, z3_dims, param_specs, world, state_axes)
                 overflow = comm.overflow_any(jnp.logical_not(finite),
                                              DATA_AXIS)
                 sq = jax.lax.psum(sq, DATA_AXIS)

@@ -34,52 +34,69 @@ from jax.ad_checkpoint import checkpoint_name
 from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 
 # Pallas attention dispatch (DSTPU_FUSED_ATTN = "auto" | "1" | "0").
-# Measured on a v5e chip (fwd+bwd vs the XLA einsum path, causal bf16):
-#   seq 128 (BERT-large):  whole-tile kernel ~8% SLOWER  -> XLA
-#   seq 512:               streaming kernel  ~parity     -> XLA
-#   seq 1024:              streaming kernel  1.67x FASTER
-#   seq 2048:              streaming kernel  1.49x FASTER
+# Measured on a v5e chip, END-TO-END training step (12-layer model,
+# selective remat — the remat replay doubles attention's share, so these
+# are the numbers that matter for users; bench_attn_sweep.json r4):
+#   GPT-2 causal:   kernel 1.10x @128, 1.14x @512, 1.86x @1024,
+#                   2.44x @2048
+#   BERT-large 128: whole-tile kernel 0.92x (375.6 vs 409.2 samples/s,
+#                   non-causal, 16 heads) -> XLA below the threshold
 # "auto" (default) uses the online-softmax streaming kernel from the
 # calibrated threshold up, XLA below; "1" forces a kernel wherever one
-# supports the shape; "0" disables both.
+# supports the shape; "0" disables both.  The causal threshold is lower:
+# the streaming kernel skips fully-masked KV tiles, which the XLA einsum
+# path cannot, and the causal end-to-end sweep shows the kernel winning
+# from 512 while the non-causal (BERT) measurement still favours XLA at
+# short lengths.
 #
-# The crossover is chip-generation dependent (the 1024 figure is the v5e
-# sweep; faster MXUs shift it).  Resolution order for the auto threshold:
-#   1. DSTPU_STREAM_ATTN_MIN env (an operator pin / calibrate() result)
-#   2. the per-device-kind table below
-#   3. the v5e-measured default (1024)
+# The crossover is chip-generation dependent.  Resolution order:
+#   1. DSTPU_STREAM_ATTN_MIN_CAUSAL env (causal-only pin — what
+#      calibrate() prints, since it measures the causal crossover)
+#   2. DSTPU_STREAM_ATTN_MIN env (applies to BOTH causal and non-causal;
+#      a causal-measured value here would force the kernel on non-causal
+#      shapes where XLA wins — prefer the causal-scoped pin)
+#   3. the per-device-kind table below
+#   4. the v5e-measured defaults
 # `ops.pallas_attention.calibrate_stream_threshold()` measures the
 # crossover on the attached chip and prints the env pin to persist.
-STREAM_AUTO_MIN = 1024
-#: measured per device kind; extend as sweeps run on new generations
+STREAM_AUTO_MIN = 1024            # non-causal default (v5e-measured)
+STREAM_AUTO_MIN_CAUSAL = 512      # causal default (v5e end-to-end sweep)
+#: measured per device kind as (causal_min, noncausal_min); extend as
+#: sweeps run on new generations
 #: (BENCH_ATTN_SWEEP=1 BENCH_SEQ=<n> python bench.py)
 STREAM_AUTO_MIN_BY_KIND = {
-    "TPU v5 lite": 1024,
-    "TPU v5e": 1024,
+    "TPU v5 lite": (512, 1024),
+    "TPU v5e": (512, 1024),
 }
 
 
-def stream_auto_min() -> int:
+def stream_auto_min(causal: bool = False) -> int:
     """The auto-dispatch threshold for the CURRENT backend (see the
     resolution order above)."""
-    env = os.environ.get("DSTPU_STREAM_ATTN_MIN")
-    if env:
+    names = (("DSTPU_STREAM_ATTN_MIN_CAUSAL", "DSTPU_STREAM_ATTN_MIN")
+             if causal else ("DSTPU_STREAM_ATTN_MIN",))
+    for name in names:
+        env = os.environ.get(name)
+        if not env:
+            continue
         try:
             v = int(env)
         except ValueError:
             raise ValueError(
-                f"DSTPU_STREAM_ATTN_MIN={env!r} is not an integer token "
-                "count") from None
+                f"{name}={env!r} is not an integer token count") from None
         if v <= 0:
             raise ValueError(
-                f"DSTPU_STREAM_ATTN_MIN={env!r} must be a positive token "
-                "count")
+                f"{name}={env!r} must be a positive token count")
         return v
+    default = STREAM_AUTO_MIN_CAUSAL if causal else STREAM_AUTO_MIN
     try:
         kind = jax.devices()[0].device_kind
     except Exception:
-        return STREAM_AUTO_MIN
-    return STREAM_AUTO_MIN_BY_KIND.get(kind, STREAM_AUTO_MIN)
+        return default
+    pair = STREAM_AUTO_MIN_BY_KIND.get(kind)
+    if pair is None:
+        return default
+    return pair[0] if causal else pair[1]
 
 
 def _attn_mode() -> str:
@@ -253,7 +270,7 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
     if mode != "0" and jax.default_backend() == "tpu":
         from deepspeed_tpu.ops import pallas_attention as pattn
         use_stream = pattn.stream_supported(T, d) and (
-            mode == "1" or T >= stream_auto_min())
+            mode == "1" or T >= stream_auto_min(causal))
         use_block = (not use_stream and mode == "1"
                      and pattn.supported(T, n_local, d))
         if use_stream or use_block:

@@ -68,6 +68,8 @@ class GPT2Pipelined(GPT2):
             raise ValueError(
                 f"unknown pipeline schedule {self.schedule!r} "
                 "(expected 'gpipe' or '1f1b')")
+        params, z3_deferred = T.zero3_enter(params, self.zero3_dims)
+        z3_block_dims = z3_deferred.get("blocks")
         x = L.vocab_parallel_embedding(tokens, params["wte"])
         x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
             x.dtype)[None]
@@ -84,7 +86,8 @@ class GPT2Pipelined(GPT2):
                            "wte": params["wte"]}
 
             def stage_1f1b(blocks, u):
-                return self._pipe_stack(u, blocks)   # (y, aux)
+                return self._pipe_stack(u, blocks,
+                                        z3_dims=z3_block_dims)   # (y, aux)
 
             def head_1f1b(hp, y, ys):
                 h = L.layer_norm(y, hp["lnf_s"], hp["lnf_b"], cfg.ln_eps)
@@ -100,8 +103,10 @@ class GPT2Pipelined(GPT2):
         def stage_fn(u):
             # inside shard_map the blocks leaf is this stage's LOCAL
             # [L/pp, ...] slice; the stack hook scans exactly those layers
-            # (with the configured remat policy)
-            return self._pipe_stack(u, params["blocks"])
+            # (with the configured remat policy; under ZeRO-3 each layer's
+            # data-partitioned weights gather inside the scan body)
+            return self._pipe_stack(u, params["blocks"],
+                                    z3_dims=z3_block_dims)
 
         x, aux = pipe_mod.pipeline_apply(x_micro, stage_fn, with_aux=True)
         # per-micro aux terms are means over their own tokens: average over
@@ -125,9 +130,9 @@ class GPT2Pipelined(GPT2):
 
         return pipe_mod.pipe_sharded_loss(x, labels, head_fn) + aux
 
-    def _pipe_stack(self, u, blocks):
+    def _pipe_stack(self, u, blocks, z3_dims=None):
         """Stage-stack hook: returns (y, aux scalar).  The MoE variant
         overrides this with the expert stack + load-balance aux."""
-        return T.stack_apply(u, blocks, self.config), 0.0
+        return T.stack_apply(u, blocks, self.config, z3_dims=z3_dims), 0.0
 
     __call__ = apply

@@ -11,11 +11,12 @@ traffic per layer that never needed to leave the chip.  Two kernels:
   score tile fits on chip (short sequences).
 * ``stream_attention`` — flash-attention-style ONLINE-SOFTMAX streaming
   over KV tiles for long sequences (gate: ``stream_supported``).  Measured
-  on a v5e chip vs the XLA einsum path (causal bf16 fwd+bwd): 1.67x at
-  seq 1024, 1.49x at seq 2048, parity at 512 — end-to-end GPT-2 124M
-  seq1024 trains 1.8x faster (selective remat replays attention, doubling
-  the kernel's share).  ``models/layers.py`` auto-dispatches from
-  ``STREAM_AUTO_MIN`` tokens.
+  on a v5e chip END-TO-END (GPT-2 training step, selective remat, causal
+  bf16; bench_attn_sweep.json): 1.14x at seq 512, 1.86x at 1024, 2.44x
+  at 2048 — the remat replay doubles attention's share, so the end-to-end
+  win exceeds the isolated fwd+bwd microbenchmark.  ``models/layers.py``
+  auto-dispatches from ``stream_auto_min(causal)`` tokens (512 causal /
+  1024 non-causal on v5e).
 
 Numerics: scores and probabilities are fp32 (max-subtracted softmax); the
 probability·V contraction runs in the input dtype (bf16 on TPU) with fp32
@@ -513,7 +514,10 @@ def calibrate_stream_threshold(seq_lens=(256, 512, 1024, 2048),
     returns the first where the kernel is >= 5% faster (falling back to
     the table default when none wins).  Persist the result with::
 
-        export DSTPU_STREAM_ATTN_MIN=<returned value>
+        export DSTPU_STREAM_ATTN_MIN_CAUSAL=<returned value>
+
+    (causal-scoped: the calibration loss is causal, and a both-axes pin
+    would force the kernel on non-causal shapes where XLA wins)
 
     Host-side utility; requires a TPU backend.
     """
@@ -572,12 +576,13 @@ def calibrate_stream_threshold(seq_lens=(256, 512, 1024, 2048),
     if threshold is None:
         # deliberately IGNORE any existing env pin here: this measurement
         # just showed the kernel losing, so fall back to the table/default
+        # (the calibration loss is causal, so read the causal column)
         kind = jax.devices()[0].device_kind
-        threshold = _L.STREAM_AUTO_MIN_BY_KIND.get(kind,
-                                                   _L.STREAM_AUTO_MIN)
+        pair = _L.STREAM_AUTO_MIN_BY_KIND.get(kind)
+        threshold = pair[0] if pair else _L.STREAM_AUTO_MIN_CAUSAL
         if verbose:
             print(f"kernel never won >=1.05x; keeping {threshold}")
     elif verbose:
         print(f"crossover at seq {threshold}: "
-              f"export DSTPU_STREAM_ATTN_MIN={threshold}")
+              f"export DSTPU_STREAM_ATTN_MIN_CAUSAL={threshold}")
     return threshold

@@ -92,23 +92,20 @@ def choose_dim(shape, spec, axis_sizes, dp: int,
 
 def choose_dims(params, specs, axis_sizes, dp: int,
                 min_size: int = DEFAULT_MIN_PARTITION_SIZE,
-                skip_flags=None, min_dims=None):
+                min_dims=None):
     """Dims tree (same structure as ``params``) of int: which dim of each
-    leaf partitions over ``data`` (-1 = replicated).  ``skip_flags`` (same
-    structure, truthy = skip) excludes leaves — e.g. sparse-gradient
-    embeddings whose grads must flow through the CSR path instead of the
-    scatter transpose.  ``min_dims`` (same structure, int) pins the lowest
-    partitionable dim per leaf (the model's ``zero3_min_dims`` hook)."""
+    leaf partitions over ``data`` (-1 = replicated).  ``min_dims`` (same
+    structure, int) pins the lowest partitionable dim per leaf (the
+    model's ``zero3_min_dims`` hook).  Sparse-gradient embeddings never
+    reach this: ``sparse_gradients`` is disabled under every ZeRO stage
+    (engine._resolve_sparse_flags), so no leaf needs a CSR escape here."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     spec_leaves = treedef.flatten_up_to(specs)
-    skips = ([False] * len(leaves) if skip_flags is None
-             else treedef.flatten_up_to(skip_flags))
     mins = ([0] * len(leaves) if min_dims is None
             else treedef.flatten_up_to(min_dims))
-    dims = [REPLICATED if skip
-            else choose_dim(tuple(l.shape), s, axis_sizes, dp, min_size,
-                            min_dim=int(md))
-            for l, s, skip, md in zip(leaves, spec_leaves, skips, mins)]
+    dims = [choose_dim(tuple(l.shape), s, axis_sizes, dp, min_size,
+                       min_dim=int(md))
+            for l, s, md in zip(leaves, spec_leaves, mins)]
     return jax.tree_util.tree_unflatten(treedef, dims)
 
 
@@ -155,17 +152,20 @@ def partitioned_any(dims) -> bool:
     return any(d >= 0 for d in jax.tree_util.tree_leaves(dims))
 
 
-def local_sqnorm_and_finite(grads, dims, specs, axis_sizes):
+def local_sqnorm_and_finite(grads, dims, specs, dp, state_axes):
     """(sum of squares, all-finite) over this device's UNIQUE grad elements.
 
     Partitioned leaves are disjoint across DP (weight 1); replicated leaves
     are identical on every DP shard, so they carry weight ``1/dp`` under
-    the later DP psum.  On top of that, leaves replicated over a
-    model/pipe state axis get ``1/size`` per such axis — the same dedup as
-    stage 1/2's ``norm_dedup_weights`` (zero.py) and the reference's
-    MP-aware norms (deepspeed_utils.py:100-158).  Returns fp32 scalars;
-    callers psum over data + the state axes."""
-    dp = int(axis_sizes.get(DATA_AXIS, 1))
+    the later DP psum.  On top of that, leaves replicated over one of the
+    ``state_axes`` (the model/pipe axes the CALLER will psum the result
+    over — and ONLY those; grads are already identical across e.g. the
+    sequence ring, which the caller never psums) get ``1/size`` per such
+    axis — the same dedup as stage 1/2's ``norm_dedup_weights`` (zero.py)
+    and the reference's MP-aware norms (deepspeed_utils.py:100-158).
+    ``state_axes`` is ``[(axis_name, size), ...]``.  Returns fp32 scalars;
+    callers psum over data + exactly ``state_axes``."""
+    dp = int(dp)
     leaves, treedef = jax.tree_util.tree_flatten(
         grads, is_leaf=lambda x: x is None)
     dim_leaves = treedef.flatten_up_to(dims)
@@ -179,8 +179,8 @@ def local_sqnorm_and_finite(grads, dims, specs, axis_sizes):
         sharded_axes = set()
         for entry in spec:
             sharded_axes.update(_spec_axes(entry))
-        for name, size in axis_sizes.items():
-            if name == DATA_AXIS or int(size) <= 1:
+        for name, size in state_axes:
+            if int(size) <= 1 or name == DATA_AXIS:
                 continue
             if name not in sharded_axes:
                 w /= int(size)

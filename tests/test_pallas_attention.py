@@ -196,15 +196,27 @@ def test_stream_threshold_resolution(monkeypatch):
 
     monkeypatch.delenv("DSTPU_STREAM_ATTN_MIN", raising=False)
     kind = jax.devices()[0].device_kind
-    # CPU test rig: kind not in the table -> the measured default
-    assert L.stream_auto_min() == L.STREAM_AUTO_MIN_BY_KIND.get(
-        kind, L.STREAM_AUTO_MIN)
+    # CPU test rig: kind not in the table -> the measured defaults,
+    # causal-aware (causal crossover is lower: the streaming kernel skips
+    # fully-masked KV tiles)
+    if kind not in L.STREAM_AUTO_MIN_BY_KIND:
+        assert L.stream_auto_min() == L.STREAM_AUTO_MIN
+        assert L.stream_auto_min(causal=True) == L.STREAM_AUTO_MIN_CAUSAL
 
-    monkeypatch.setitem(L.STREAM_AUTO_MIN_BY_KIND, kind, 512)
-    assert L.stream_auto_min() == 512          # table entry wins default
+    monkeypatch.setitem(L.STREAM_AUTO_MIN_BY_KIND, kind, (256, 512))
+    assert L.stream_auto_min(causal=True) == 256   # table wins default
+    assert L.stream_auto_min() == 512
 
     monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN", "2048")
     assert L.stream_auto_min() == 2048         # env pin wins everything
+    assert L.stream_auto_min(causal=True) == 2048
+
+    # the causal-scoped pin (what calibrate() prints) never leaks into
+    # non-causal dispatch — a causal-measured crossover would force the
+    # kernel on non-causal shapes where XLA wins
+    monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN_CAUSAL", "256")
+    assert L.stream_auto_min(causal=True) == 256
+    assert L.stream_auto_min() == 2048
 
     monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN", "-3")
     with pytest.raises(ValueError, match="positive"):

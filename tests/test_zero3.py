@@ -157,12 +157,42 @@ def test_zero3_with_context_parallel():
     np.testing.assert_allclose(l0, l3, rtol=5e-3, atol=5e-3)
 
 
+def test_zero3_sp_grad_norm_not_deduped_over_seq():
+    # grads are already identical across the sequence ring (the engine
+    # psums + /sp them before the norm) and the stage-3 norm psums over
+    # data + model/pipe ONLY — dividing replicated leaves by sp as well
+    # would shrink the norm by sqrt(sp) and under-clip (review r4 finding)
+    e0 = make_engine(0, sp=2, gradient_clipping=0.05)
+    e3 = make_engine(3, sp=2, gradient_clipping=0.05)
+    l0 = run_steps(e0, 2)
+    l3 = run_steps(e3, 2)
+    np.testing.assert_allclose(l0, l3, rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(float(e0._last_grad_norm),
+                               float(e3._last_grad_norm), rtol=1e-2)
+
+
 def test_zero3_grad_accumulation_split_vs_fused():
     ls = run_steps(make_engine(3, gas=2), split=True)
     lf = run_steps(make_engine(3, gas=2), split=False)
     # split slices micro-batches globally, fused scans per-shard rows —
     # same summed gradient, micro-order differs (engine.train_batch doc)
     np.testing.assert_allclose(ls, lf, rtol=3e-2, atol=3e-2)
+
+
+def test_zero3_moe():
+    from deepspeed_tpu.models import GPT2MoE
+
+    def make(stage):
+        model = GPT2MoE.from_size(
+            "tiny", num_experts=4, capacity_factor=2.0, vocab_size=VOCAB,
+            max_seq_len=SEQ, num_layers=2, hidden_size=32, num_heads=4)
+        return make_engine(stage, mp=2, model=model)
+
+    out = []
+    for stage in (0, 3):
+        eng = make(stage)
+        out.append(run_steps(eng, 2))
+    np.testing.assert_allclose(out[0], out[1], rtol=5e-3, atol=5e-3)
 
 
 def test_zero3_bert():
@@ -303,19 +333,32 @@ def test_zero3_rejects_parameter_parallel_size():
                                           "parameter_parallel_size": 2})
 
 
-def test_zero3_rejects_pipeline():
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_zero3_with_pipeline(schedule):
     from deepspeed_tpu.models.pipeline_gpt2 import GPT2Pipelined
-    model = GPT2Pipelined.from_size(
-        "tiny", vocab_size=VOCAB, max_seq_len=SEQ, num_layers=2,
-        hidden_size=32, num_heads=4)
-    cfg = {"train_batch_size": 8, "bf16": {"enabled": True},
-           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-           "zero_optimization": {"stage": 3},
-           "pipeline_parallel_size": 2}
-    with pytest.raises(DeepSpeedConfigError, match="pipeline"):
-        deepspeed_tpu.initialize(
+
+    def make(stage):
+        model = GPT2Pipelined.from_size(
+            "tiny", vocab_size=VOCAB, max_seq_len=SEQ, num_layers=2,
+            hidden_size=32, num_heads=4, num_micro_batches=2,
+            schedule=schedule)
+        cfg = {"train_batch_size": 8, "bf16": {"enabled": True},
+               "steps_per_print": 10 ** 6,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": stage}}
+        engine, _, _, _ = deepspeed_tpu.initialize(
             config=cfg, model=model,
-            model_parameters=model.init_params(jax.random.PRNGKey(0)))
+            model_parameters=model.init_params(jax.random.PRNGKey(7)),
+            mesh=make_mesh(pipeline_parallel_size=2))
+        return engine
+
+    l0 = run_steps(make(0), 2)
+    l3 = run_steps(make(3), 2)
+    np.testing.assert_allclose(l0, l3, rtol=5e-3, atol=5e-3)
+    # the stage-3 engine really partitioned the per-stage stacks
+    e3 = make(3)
+    qkv = e3.master["blocks"]["qkv_w"]
+    assert qkv.addressable_shards[0].data.size * 8 == qkv.size  # pp*dp*...
 
 
 def test_zero3_grad_norm_and_clipping_match_stage0():
