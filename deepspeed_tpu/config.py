@@ -121,6 +121,12 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"{C.PIPELINE_SCHEDULE} must be 'gpipe' or '1f1b', got "
                 f"{self.pipeline_schedule!r}")
+        self.sequence_parallel_impl = get_scalar_param(
+            pd, C.SEQUENCE_PARALLEL_IMPL, C.SEQUENCE_PARALLEL_IMPL_DEFAULT)
+        if self.sequence_parallel_impl not in (None, "ring", "ulysses"):
+            raise DeepSpeedConfigError(
+                f"{C.SEQUENCE_PARALLEL_IMPL} must be 'ring' or 'ulysses', "
+                f"got {self.sequence_parallel_impl!r}")
         self.sparse_gradients_max_rows = get_scalar_param(
             pd, C.SPARSE_GRADIENTS_MAX_ROWS,
             C.SPARSE_GRADIENTS_MAX_ROWS_DEFAULT)

@@ -191,6 +191,8 @@ PIPELINE_PARALLEL_SIZE = "pipeline_parallel_size"
 PIPELINE_PARALLEL_SIZE_DEFAULT = 1
 PIPELINE_SCHEDULE = "pipeline_schedule"
 PIPELINE_SCHEDULE_DEFAULT = None          # None | "gpipe" | "1f1b"
+SEQUENCE_PARALLEL_IMPL = "sequence_parallel_impl"
+SEQUENCE_PARALLEL_IMPL_DEFAULT = None     # None | "ring" | "ulysses"
 
 ZERO_PARAMETER_PARALLEL_SIZE = "parameter_parallel_size"
 ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT = None

@@ -403,6 +403,22 @@ class DeepSpeedTpuEngine:
                 "models.transformer.token_batch_specs for the standard "
                 "[B, T] token-batch layout.")
 
+        # Config-beats-model overrides below MUTATE the model object.  Users
+        # and the repo's own tests reuse one model instance across several
+        # engines, and every engine traces its step functions lazily — a
+        # shared mutation would silently retrace ANOTHER engine's step with
+        # THIS engine's settings.  First override takes a shallow copy
+        # (same rationale as the ZeRO-3 zero3_dims hand-off below).
+        self._model_owned = False
+
+        def _own_model():
+            nonlocal model
+            if not self._model_owned:
+                import copy
+                model = self.module = copy.copy(model)
+                self._model_owned = True
+            return model
+
         # -- activation checkpointing override (config beats the model's own
         #    remat flag; the reference's analog is Megatron's
         #    --checkpoint-activations, ds_gpt2_test.sh gpt_options)
@@ -415,7 +431,7 @@ class DeepSpeedTpuEngine:
                 pol = self.config.activation_checkpointing_policy
                 if pol is not None and hasattr(mcfg, "remat_policy"):
                     repl["remat_policy"] = pol
-                model.config = _dc.replace(mcfg, **repl)
+                _own_model().config = _dc.replace(mcfg, **repl)
             else:
                 logger.warning(
                     "activation_checkpointing set but the model exposes no "
@@ -426,11 +442,35 @@ class DeepSpeedTpuEngine:
         ps = self.config.pipeline_schedule
         if ps is not None:
             if hasattr(model, "schedule"):
-                model.schedule = ps
+                _own_model().schedule = ps
             else:
                 logger.warning(
                     "pipeline_schedule set but the model exposes no "
                     "schedule field; ignored")
+
+        # -- sequence-parallel strategy override (ring | ulysses)
+        spi = self.config.sequence_parallel_impl
+        if spi is not None:
+            mcfg = getattr(model, "config", None)
+            if mcfg is not None and hasattr(mcfg, "sp_impl"):
+                import dataclasses as _dc
+                _own_model().config = _dc.replace(mcfg, sp_impl=spi)
+            else:
+                logger.warning(
+                    "sequence_parallel_impl set but the model exposes no "
+                    "sp_impl config field; ignored")
+        if self.sp_world_size > 1:
+            mcfg = getattr(model, "config", None)
+            if (mcfg is not None and getattr(mcfg, "sp_impl", None)
+                    == "ulysses"):
+                n_local = mcfg.num_heads // max(self.mp_world_size, 1)
+                if n_local % self.sp_world_size:
+                    raise DeepSpeedConfigError(
+                        f"sequence_parallel_impl='ulysses' needs local "
+                        f"heads ({mcfg.num_heads}/{self.mp_world_size} = "
+                        f"{n_local}) divisible by context_parallel_size "
+                        f"({self.sp_world_size}); use 'ring' for "
+                        f"head-limited models")
 
         # -- precision policy
         self.policy = prec.policy_from_config(self.config.fp16_enabled,
@@ -557,12 +597,16 @@ class DeepSpeedTpuEngine:
                     "(stage-1-like memory)", self.dp_world_size)
             self._param_specs = zero3_mod.augment_specs(self._param_specs,
                                                         self._zero3_dims)
-            # hand the dims to the model on a SHALLOW COPY: examples and
-            # tests reuse one model object across several engines, and a
-            # stage-0 engine tracing a shared instance with zero3_dims set
-            # would gather unpartitioned leaves dp-fold
-            import copy
-            model = self.module = copy.copy(model)
+            # hand the dims to an engine-OWNED copy: a stage-0 engine
+            # tracing a shared instance with zero3_dims set would gather
+            # unpartitioned leaves dp-fold (same ownership rule as the
+            # config-override block in __init__)
+            if not self._model_owned:
+                import copy
+                model = self.module = copy.copy(self.module)
+                self._model_owned = True
+            else:
+                model = self.module
             model.zero3_dims = self._zero3_dims
         if param_groups is None and self.client_optimizer is None:
             # pure-JSON spelling (optimizer.param_groups); the explicit

@@ -237,50 +237,28 @@ def gelu(x):
     return y.astype(x.dtype)
 
 
-def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
-                        *, n_heads_global, causal, attn_mask=None,
-                        axis=MODEL_AXIS):
-    """Tensor-parallel multi-head attention over local heads.
-
-    x:            [B, T, h] replicated over ``model``
-    qkv_w_local:  [h, 3h/mp]  packed head-major (n_local, 3, d)
-    qkv_b_local:  [3h/mp]
-    proj_w_local: [h/mp, h]   row-parallel output projection
-    proj_b:       [h]         replicated
-    attn_mask:    optional [B, T] with 1=attend, 0=pad (BERT)
-    """
-    B, T, h = x.shape
-    d = h // n_heads_global
-    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)  # [B,T,3h/mp]
-    # named for the "selective" remat policy: saving qkv lets backward
-    # recompute attention (cheap einsums) without replaying the qkv matmul
-    qkv = checkpoint_name(qkv, "qkv")
-    n_local = qkv.shape[-1] // (3 * d)
-    qkv = qkv.reshape(B, T, n_local, 3, d)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]   # [B,T,n,d]
-
-    if axis_size_or_1(SEQ_AXIS) > 1:
-        # sequence-sharded: exact blockwise attention over the ring
-        from deepspeed_tpu.models.ring_attention import ring_attention
-        ctx = ring_attention(q, k, v, causal=causal, kv_mask=attn_mask)
-        ctx = ctx.reshape(B, T, n_local * d)
-        return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
-
+def core_attention(q, k, v, *, causal, attn_mask=None):
+    """Single-device attention on [B, T, n, d] q/k/v with the kernel
+    dispatch table: streaming Pallas kernel from the calibrated threshold
+    (causal-aware), whole-tile kernel under force mode, XLA einsum
+    otherwise.  ``attn_mask``: optional [B, T] float/int, 1 = attend.
+    Shared by the plain path and Ulysses sequence parallelism (which
+    calls it on the all-to-all'd full-sequence view — so long-context
+    kernels and sequence sharding compose)."""
+    B, T, n, d = q.shape
     mode = _attn_mode()
     if mode != "0" and jax.default_backend() == "tpu":
         from deepspeed_tpu.ops import pallas_attention as pattn
         use_stream = pattn.stream_supported(T, d) and (
             mode == "1" or T >= stream_auto_min(causal))
         use_block = (not use_stream and mode == "1"
-                     and pattn.supported(T, n_local, d))
+                     and pattn.supported(T, n, d))
         if use_stream or use_block:
             mvec = (jnp.ones((B, T), jnp.float32) if attn_mask is None
                     else attn_mask.astype(jnp.float32))
             impl = (pattn.stream_attention if use_stream
                     else pattn.fused_attention)
-            ctx = impl(q, k, v, mvec, causal)
-            ctx = ctx.reshape(B, T, n_local * d)
-            return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
+            return impl(q, k, v, mvec, causal)
 
     # fp32 accumulation on the MXU (free) instead of a bf16 einsum + upcast
     scores = jnp.einsum("btnd,bsnd->bnts", q, k,
@@ -292,7 +270,56 @@ def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
     if attn_mask is not None:
         scores = jnp.where(attn_mask[:, None, None, :].astype(jnp.bool_),
                            scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bnts,bsnd->btnd", probs, v)               # [B,T,n,d]
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnts,bsnd->btnd", probs, v)              # [B,T,n,d]
+
+
+def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,
+                        *, n_heads_global, causal, attn_mask=None,
+                        axis=MODEL_AXIS, sp_impl="ring"):
+    """Tensor-parallel multi-head attention over local heads.
+
+    x:            [B, T, h] replicated over ``model``
+    qkv_w_local:  [h, 3h/mp]  packed head-major (n_local, 3, d)
+    qkv_b_local:  [3h/mp]
+    proj_w_local: [h/mp, h]   row-parallel output projection
+    proj_b:       [h]         replicated
+    attn_mask:    optional [B, T] with 1=attend, 0=pad (BERT)
+    sp_impl:      sequence-parallel strategy when the ``seq`` axis is
+                  sharded: "ring" (K/V rotation, nearest-neighbour ICI
+                  only) or "ulysses" (head<->sequence all-to-all; each
+                  shard sees the FULL sequence for n/sp heads, so the
+                  streaming kernel dispatch applies — models/ulysses.py)
+    """
+    B, T, h = x.shape
+    d = h // n_heads_global
+    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)  # [B,T,3h/mp]
+    # named for the "selective" remat policy: saving qkv lets backward
+    # recompute attention (cheap einsums) without replaying the qkv matmul
+    qkv = checkpoint_name(qkv, "qkv")
+    n_local = qkv.shape[-1] // (3 * d)
+    qkv = qkv.reshape(B, T, n_local, 3, d)
+
+    if axis_size_or_1(SEQ_AXIS) > 1 and sp_impl == "ulysses":
+        # packed entry point: one all-to-all moves q, k and v together
+        from deepspeed_tpu.models.ulysses import ulysses_attention_packed
+        ctx = ulysses_attention_packed(qkv, causal=causal,
+                                       attn_mask=attn_mask)
+        ctx = ctx.reshape(B, T, n_local * d)
+        return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
+
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]   # [B,T,n,d]
+
+    if axis_size_or_1(SEQ_AXIS) > 1:
+        if sp_impl == "ring":
+            # sequence-sharded: exact blockwise attention over the ring
+            from deepspeed_tpu.models.ring_attention import ring_attention
+            ctx = ring_attention(q, k, v, causal=causal, kv_mask=attn_mask)
+        else:
+            raise ValueError(
+                f"unknown sequence_parallel_impl {sp_impl!r} "
+                "(expected 'ring' or 'ulysses')")
+    else:
+        ctx = core_attention(q, k, v, causal=causal, attn_mask=attn_mask)
     ctx = ctx.reshape(B, T, n_local * d)                        # [B,T,h/mp]
     return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)

@@ -58,6 +58,10 @@ class TransformerConfig:
     pre_ln: bool = True           # GPT-2 pre-LN; BERT uses post-LN
     causal: bool = True
     remat: bool = True            # per-block activation checkpointing
+    # sequence-parallel attention strategy under context_parallel_size>1:
+    # "ring" (K/V rotation) or "ulysses" (head<->seq all-to-all); the
+    # engine's sequence_parallel_impl JSON key overrides this field
+    sp_impl: str = "ring"
     # "full": recompute everything in backward (max memory savings, ~33%
     # extra FLOPs).  "dots": save matmul outputs, recompute only cheap
     # elementwise/softmax/LN — the usual TPU sweet spot when HBM allows.
@@ -136,7 +140,7 @@ def block_with_ffn(x, p, cfg: TransformerConfig, attn_mask=None, ffn=None):
     attn = lambda u: L.multihead_attention(
         u, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"],
         n_heads_global=cfg.num_heads, causal=cfg.causal,
-        attn_mask=attn_mask)
+        attn_mask=attn_mask, sp_impl=cfg.sp_impl)
     ln1 = lambda u: L.layer_norm(u, p["ln1_s"], p["ln1_b"], cfg.ln_eps)
     ln2 = lambda u: L.layer_norm(u, p["ln2_s"], p["ln2_b"], cfg.ln_eps)
     if cfg.pre_ln:

@@ -1,0 +1,78 @@
+"""Ulysses-style sequence parallelism: head<->sequence all-to-all.
+
+The second long-context strategy beside ring attention (both beyond the
+reference — SURVEY.md §2.3 row 22: no sequence/context parallelism
+anywhere in the reference).  Where the ring rotates K/V blocks around the
+``seq`` axis (sp ppermute rounds, O(T/sp) peak scores per shard), Ulysses
+re-partitions ONCE per attention: an all-to-all exchanges the sharded
+sequence dim for the head dim, so each device holds the FULL sequence for
+``n/sp`` of its heads, runs an ordinary (single-device) attention, and
+all-to-alls back.  Two collectives per layer instead of sp ppermute
+rounds, and the local attention sees the complete [T, T] extent — which
+means ``layers.core_attention``'s streaming-kernel dispatch applies
+unchanged, composing the Pallas flash kernel with sequence sharding.
+
+Trade-offs (the honest table):
+* Ulysses moves 2 x the qkv+ctx activations through one all-to-all pair;
+  the ring moves K/V sp times but overlaps each hop with compute.
+* Ulysses degree is capped by the head count (``n_local % sp == 0``);
+  the ring shards any length regardless of heads.
+* Peak score memory: ring O((T/sp)^2) per block fold vs Ulysses the
+  kernel's tile budget (streaming) or O(T^2) (XLA path) — for very long
+  sequences run Ulysses WITH the streaming kernel, or use the ring.
+
+Select per model via ``TransformerConfig.sp_impl`` or the engine's
+``sequence_parallel_impl`` JSON key (docs/config.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.parallel.topology import SEQ_AXIS
+
+
+def ulysses_attention_packed(qkv, *, causal=True, attn_mask=None,
+                             axis=SEQ_AXIS):
+    """qkv: [B, Tl, n_local, 3, d] packed head-major — the LOCAL sequence
+    shard (inside shard_map).  ONE all-to-all moves q, k and v together
+    (three separate collectives would move the same bytes with 3x the
+    launch latency; manual collectives inside shard_map are not fused).
+    attn_mask: optional [B, Tl] with 1 = attend.
+    Returns [B, Tl, n_local, d].
+
+    Requires ``n_local % sp == 0`` (heads after tensor parallelism must
+    split over the sequence-parallel degree)."""
+    sp = jax.lax.axis_size(axis)
+    B, Tl, n, three, d = qkv.shape
+    if n % sp:
+        raise ValueError(
+            f"ulysses attention needs local heads ({n}) divisible by the "
+            f"sequence-parallel degree ({sp}); use sp_impl='ring' for "
+            f"head-limited models, or lower context_parallel_size")
+
+    # split the local head dim sp ways, concatenate received sequence
+    # blocks: [B, Tl, n, 3, d] -> [B, Tl*sp, n/sp, 3, d]
+    g = jax.lax.all_to_all(qkv, axis, split_axis=2, concat_axis=1,
+                           tiled=True)
+    qg, kg, vg = g[..., 0, :], g[..., 1, :], g[..., 2, :]
+    mask_full = None
+    if attn_mask is not None:
+        mask_full = jax.lax.all_gather(attn_mask, axis, axis=1, tiled=True)
+
+    ctx = L.core_attention(qg, kg, vg, causal=causal, attn_mask=mask_full)
+
+    # inverse exchange: split the (full) sequence back, regather heads
+    return jax.lax.all_to_all(ctx, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, *, causal=True, attn_mask=None,
+                      axis=SEQ_AXIS):
+    """Unpacked-q/k/v convenience wrapper over
+    ``ulysses_attention_packed`` (q, k, v: [B, Tl, n_local, d])."""
+    return ulysses_attention_packed(
+        jnp.stack([q, k, v], axis=3), causal=causal, attn_mask=attn_mask,
+        axis=axis)

@@ -55,11 +55,13 @@ def build_model(name, seq=SEQ, pipelined=False, **over):
     return GPT2.from_size(size, vocab_size=VOCAB, max_seq_len=seq, **over)
 
 
-def aot_compile(model, mesh, bs, seq):
+def aot_compile(model, mesh, bs, seq, specs=None):
     """Lower+compile the fwd+bwd shard_map program from abstract args
     (fp16 compute dtype, never allocated); returns (compiled, abstract
-    fp32 param tree)."""
-    specs = model.partition_specs(None)
+    fp32 param tree).  ``specs`` overrides the model's own partition
+    specs (the ZeRO-3 test passes the data-augmented tree)."""
+    if specs is None:
+        specs = model.partition_specs(None)
     abstract = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
     params_abs = jax.tree_util.tree_map(
         lambda l: jax.ShapeDtypeStruct(l.shape, jnp.float16), abstract)
@@ -172,20 +174,8 @@ def test_1_5b_aot_compiles_zero3_fsdp():
                              min_dims=model.zero3_min_dims(abstract))
     specs = zero3.augment_specs(base_specs, dims)
     model.zero3_dims = dims
-
-    params_abs = jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.float16), abstract)
-    toks = jax.ShapeDtypeStruct((bs, SEQ), jnp.int32)
-    labels = jax.ShapeDtypeStruct((bs, SEQ), jnp.int32)
-
-    def local(p, t, l):
-        return jax.value_and_grad(lambda q: model.apply(q, t, l))(p)
-
-    fn = jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(specs, P("data", None), P("data", None)),
-        out_specs=(P(), specs), check_vma=False))
-    ma = fn.lower(params_abs, toks, labels).compile().memory_analysis()
+    compiled, _ = aot_compile(model, mesh, bs, SEQ, specs=specs)
+    ma = compiled.memory_analysis()
 
     # per-device param bytes: partitioned leaves divide by dp on top of mp
     spec_leaves = jax.tree_util.tree_structure(abstract).flatten_up_to(specs)

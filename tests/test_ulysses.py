@@ -1,0 +1,152 @@
+"""Ulysses sequence parallelism: head<->sequence all-to-all attention.
+
+The second long-context strategy beside ring attention (models/ulysses.py;
+beyond the reference, SURVEY §2.3 row 22).  Pinned here: exactness against
+single-device attention (forward AND backward, causal + padding masks),
+engine-level trajectory parity with sp=1 and with the ring, the head
+divisibility guard, and the config plumbing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.models.ulysses import ulysses_attention
+from deepspeed_tpu.parallel.topology import make_mesh
+
+pytestmark = pytest.mark.slow
+
+VOCAB, SEQ = 64, 16
+
+
+def seq_mesh(sp):
+    return Mesh(np.array(jax.devices()[:sp]).reshape(sp), ("seq",))
+
+
+def rand_qkvm(B=2, T=32, n=4, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, n, d)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray(rng.random((B, T)) > 0.2, jnp.float32)
+    return q, k, v, mask
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_core_attention(sp, causal):
+    q, k, v, mask = rand_qkvm()
+    ref = L.core_attention(q, k, v, causal=causal, attn_mask=mask)
+    fn = jax.jit(jax.shard_map(
+        lambda a, b, c, m: ulysses_attention(a, b, c, causal=causal,
+                                             attn_mask=m),
+        mesh=seq_mesh(sp), in_specs=(P(None, "seq"),) * 4,
+        out_specs=P(None, "seq"), check_vma=False))
+    np.testing.assert_allclose(np.asarray(fn(q, k, v, mask)),
+                               np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_gradients_match():
+    sp = 4
+    q, k, v, mask = rand_qkvm()
+    mesh = seq_mesh(sp)
+
+    def loss_sharded(a, b, c):
+        o = jax.shard_map(
+            lambda x, y, z, m: ulysses_attention(x, y, z, causal=True,
+                                                 attn_mask=m),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 4,
+            out_specs=P(None, "seq"), check_vma=False)(a, b, c, mask)
+        return jnp.sum(o ** 2)
+
+    def loss_ref(a, b, c):
+        return jnp.sum(
+            L.core_attention(a, b, c, causal=True, attn_mask=mask) ** 2)
+
+    g1 = jax.grad(loss_sharded, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ulysses_head_divisibility_error():
+    q, k, v, mask = rand_qkvm(n=3)   # 3 heads, sp=2: not divisible
+    fn = jax.shard_map(
+        lambda a, b, c, m: ulysses_attention(a, b, c, attn_mask=m),
+        mesh=seq_mesh(2), in_specs=(P(None, "seq"),) * 4,
+        out_specs=P(None, "seq"), check_vma=False)
+    with pytest.raises(ValueError, match="divisible"):
+        fn(q, k, v, mask)
+
+
+# ------------------------------------------------------------ engine level
+
+def make_engine(sp=1, impl=None, mp=1, seed=7):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 10 ** 6,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+    }
+    if impl is not None:
+        cfg["sequence_parallel_impl"] = impl
+    model = GPT2.from_size("tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                           num_layers=2, hidden_size=32, num_heads=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(seed)),
+        mesh=make_mesh(model_parallel_size=mp, context_parallel_size=sp))
+    return engine
+
+
+def run_steps(engine, n=3):
+    rng = np.random.default_rng(1)
+    out = []
+    for _ in range(n):
+        toks = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+        labels = np.roll(toks, -1, axis=1)
+        labels[:, -1] = -1
+        out.append(float(engine.train_batch((toks, labels))))
+    return out
+
+
+def test_engine_ulysses_matches_sp1_and_ring():
+    base = run_steps(make_engine(sp=1))
+    uly = run_steps(make_engine(sp=2, impl="ulysses"))
+    ring = run_steps(make_engine(sp=2, impl="ring"))
+    np.testing.assert_allclose(base, uly, rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(ring, uly, rtol=5e-3, atol=5e-3)
+
+
+def test_engine_ulysses_head_guard():
+    # 4 heads / mp=2 = 2 local heads; sp=4 does not divide -> config error
+    with pytest.raises(DeepSpeedConfigError, match="divisible"):
+        make_engine(sp=4, impl="ulysses", mp=2)
+
+
+def test_config_rejects_unknown_impl():
+    with pytest.raises(DeepSpeedConfigError, match="ulysses"):
+        make_engine(sp=2, impl="spiral")
+
+
+def test_impl_override_does_not_mutate_shared_model():
+    # config-beats-model overrides act on an engine-owned copy: a second
+    # engine built from the same model object must keep its own strategy
+    model = GPT2.from_size("tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                           num_layers=2, hidden_size=32, num_heads=4)
+    cfg = {"train_batch_size": 8, "steps_per_print": 10 ** 6,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "bf16": {"enabled": True},
+           "sequence_parallel_impl": "ulysses"}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(7)),
+        mesh=make_mesh(context_parallel_size=2))
+    assert model.config.sp_impl == "ring"          # untouched
+    assert engine.module.config.sp_impl == "ulysses"
+    assert engine.module is not model
