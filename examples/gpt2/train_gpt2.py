@@ -18,6 +18,12 @@ chip count):
         --vocab 50304 --micro-batches 2 \
         --deepspeed_config examples/gpt2/ds_config_perf_4b.json
 
+Everything else rides the JSON config unchanged: ZeRO-3 parameter
+partitioning is ``"zero_optimization": {"stage": 3}``
+(ds_config_zero3.json), long sequences shard with
+``"context_parallel_size": N`` plus ``"sequence_parallel_impl":
+"ring" | "ulysses"`` (docs/config.md).
+
 Multi-host: bin/dst --hostfile <hf> examples/gpt2/train_gpt2.py ...
 """
 
