@@ -385,15 +385,19 @@ def main():
     on_tpu = jax.devices()[0].platform == "tpu"
     seq = int(os.environ.get("BENCH_SEQ", "128"))
     size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "tiny")
-    # r4 sweep (BENCH_SWEEP=1, committed in bench_headline.json): batch 48
-    # beats 96 at seq128 — 430.2 vs 409.5 samples/s/chip with selective
-    # remat — the smaller live batch keeps more of the fused fwd+bwd in
-    # CMEM/VMEM; remat=False fails to compile at any batch (score tensors
-    # exceed HBM without the replay)
+    # r4 sweep (BENCH_SWEEP=1 + manual refinement, bench_headline.json):
+    # micro-batch 24 x gas 48 beats the old 96 x 16 by 10% at seq128 —
+    # 448.9 vs 409.5 samples/s/chip with selective remat.  The smaller
+    # live micro-batch keeps the fused fwd+bwd working set closer to
+    # VMEM and the longer accumulation scan amortises the LAMB step;
+    # global batch stays in the published LAMB recipe range
+    # (bert-pretraining.md 16K-64K: 24 x 48 x 32 chips = 36.9K).
+    # remat=False fails to compile at any batch (score tensors exceed
+    # HBM without the replay); full remat peaks lower end-to-end.
     batch_per_chip = int(os.environ.get(
-        "BENCH_BATCH", "48" if on_tpu else "8"))
+        "BENCH_BATCH", "24" if on_tpu else "8"))
     steps = int(os.environ.get("BENCH_STEPS", "8" if on_tpu else "4"))
-    gas = int(os.environ.get("BENCH_GAS", "16" if on_tpu else "1"))
+    gas = int(os.environ.get("BENCH_GAS", "48" if on_tpu else "1"))
     remat_env = os.environ.get("BENCH_REMAT", "selective")
     remat = {"0": False, "1": True, "false": False, "true": True}.get(
         remat_env.lower(), remat_env)   # "selective"/"dots"/"full" pass
