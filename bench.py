@@ -387,7 +387,7 @@ def main():
     size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "tiny")
     # r4 sweep (BENCH_SWEEP=1 + manual refinement, bench_headline.json):
     # micro-batch 24 x gas 48 beats the old 96 x 16 by 10% at seq128 —
-    # 448.9 vs 409.5 samples/s/chip with selective remat.  The smaller
+    # 449.05 vs 409.5 samples/s/chip with selective remat.  The smaller
     # live micro-batch keeps the fused fwd+bwd working set closer to
     # VMEM and the longer accumulation scan amortises the LAMB step;
     # global batch stays in the published LAMB recipe range

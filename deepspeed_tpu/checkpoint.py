@@ -257,14 +257,29 @@ def _collect_mp_states(tree, specs, mp_size: int):
     return _collect_shard_states(tree, specs, [(MODEL_AXIS, mp_size)])
 
 
+def _host_full(leaf):
+    """The full global value of a (possibly data-sharded) jax.Array on
+    this host.  Multi-host arrays are not fully addressable, so gather
+    across processes first — checkpointing is infrequent and the DCN
+    bytes match what the reference's torch.save of replicated state
+    moves anyway."""
+    if (getattr(leaf, "is_fully_addressable", True)
+            or getattr(leaf, "is_fully_replicated", False)):
+        # replicated multi-host leaves fetch from a local shard — no
+        # collective needed
+        return np.asarray(leaf)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+
+
 def _collect_composite_full(tree, specs, axes):
     """ZeRO-3 collector: materialise each (data-sharded) global leaf fully
     on host, then slice per composite (pipe, model) rank — so the written
     files carry data-FULL, composite-local leaves, i.e. exactly the
     stage-<=2 model-state format.  Restores therefore work under ANY
     topology/stage (the data partitioning re-materialises from the
-    engine's shardings at device_put).  Single-controller only: the full
-    np.asarray needs every shard addressable (save_checkpoint guards)."""
+    engine's shardings at device_put); multi-host arrays gather across
+    processes (``_host_full``)."""
     sizes = [n for _, n in axes]
     S = 1
     for n in sizes:
@@ -273,7 +288,7 @@ def _collect_composite_full(tree, specs, axes):
     spec_leaves = treedef.flatten_up_to(specs)
     per_rank = [[] for _ in range(S)]
     for leaf, spec in zip(leaves, spec_leaves):
-        full = np.asarray(leaf)
+        full = _host_full(leaf)
         dims = [_axis_dim(spec, name) for name, _ in axes]
         for r in range(S):
             rem, comps = r, []
@@ -304,11 +319,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     axes = _state_axes(pp, mp)
     zero_flat = getattr(engine, "zero_flat", engine.zero_enabled)
     zero3 = getattr(engine, "zero3", False)
-    if zero3 and jax.process_count() > 1:
-        raise NotImplementedError(
-            "ZeRO-3 checkpoint save reassembles data-sharded leaves on the "
-            "host, which needs every shard addressable — multi-host stage-3 "
-            "saves are not supported yet (stages 1-2 are)")
     scalar_state = {
         "loss_scale_state": _to_np(engine.loss_scale_state._asdict()),
         "loss_scale_variant": engine._ls_variant,

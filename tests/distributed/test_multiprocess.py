@@ -51,6 +51,14 @@ def test_zero2_checkpoint_resume_multiprocess(tmpdir):
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
 
 
+def test_zero3_checkpoint_resume_multiprocess(tmpdir):
+    """ZeRO-3 (FSDP) across real processes: data-sharded params/masters
+    gather across hosts on save (checkpoint._host_full) and a fresh
+    engine resumes to the unbroken trajectory."""
+    spawn_distributed("zero3_ckpt_resume", world_size=2, local_devices=2,
+                      env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
+
+
 def test_zero_pps_mp_checkpoint_resume_multiprocess(tmpdir):
     """pps=2 x mp=2 x dp=4 across real processes (VERDICT r3 item 9): the
     block-tiled [S, local] rows save only distinct partitions and resume
