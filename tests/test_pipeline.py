@@ -179,7 +179,11 @@ def test_1f1b_training_matches_plain():
     got, engine = run_engine(
         pipelined, make_mesh(pipeline_parallel_size=2),
         pipeline_schedule="1f1b")
-    assert pipelined.schedule == "1f1b"  # config override reached the model
+    # the config override reaches an ENGINE-OWNED copy; the caller's model
+    # object keeps its own schedule (overrides must not leak into other
+    # engines sharing the instance — see engine._own_model)
+    assert engine.module.schedule == "1f1b"
+    assert pipelined.schedule == "gpipe"
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
     engine.eval()
     toks, labels = lm_batch(8, seed=99)
