@@ -2,6 +2,7 @@ from deepspeed_tpu.ops.optim import (  # noqa: F401
     Adam,
     AdamW,
     Lamb,
+    Lion,
     Sgd,
     Optimizer,
     OptimizerState,

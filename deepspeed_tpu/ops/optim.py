@@ -299,6 +299,51 @@ class Sgd(Optimizer):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lion(Optimizer):
+    """Lion — EvoLved Sign Momentum (Chen et al. 2023, arXiv:2302.06675).
+
+    Beyond-reference breadth: a TPU-popular optimizer with HALF of Adam's
+    state (one momentum, no second moment — pairs with the ZeRO memory
+    story).  Update: ``u = sign(b1·m + (1-b1)·g); p -= lr·(u + wd·p);
+    m = b2·m + (1-b2)·g``.  Decay is decoupled (AdamW-style) per the
+    paper.  Under fp16 the combined unscale factor divides the gradient
+    before both the sign interpolation and the momentum update; note the
+    sign makes the UPDATE invariant to pure rescaling, so clipping only
+    shifts the interpolation weighting — document-not-surprise.  Paper
+    defaults: lr 1e-4 (use ~1/10 of the Adam lr), betas (0.9, 0.99)."""
+    name: str = "lion"
+    lr: float = 1e-4
+    beta1: float = 0.9
+    beta2: float = 0.99
+
+    def init(self, params) -> OptimizerState:
+        return OptimizerState(step=jnp.zeros((), jnp.int32),
+                              m=_zeros_like_tree(params), v=None)
+
+    def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
+               weight_decay=None, combined_scale=1.0):
+        step = state.step + 1
+        treedef, rows = self._flat_hypers(params, grads, state,
+                                          lr, beta1, beta2, weight_decay)
+
+        def leaf(p, g, m, _v, hy):
+            if g is None:
+                return p, m
+            lr_l, b1, b2, wd = self._resolve(*hy)
+            sg = g.astype(jnp.float32) / combined_scale
+            u = jnp.sign(b1 * m + (1.0 - b1) * sg)
+            p_new = p - lr_l * (u + wd * p)
+            m_new = b2 * m + (1.0 - b2) * sg
+            return p_new, m_new
+
+        out = [leaf(*r) for r in rows]
+        return (treedef.unflatten([o[0] for o in out]),
+                OptimizerState(step=step,
+                               m=treedef.unflatten([o[1] for o in out]),
+                               v=None))
+
+
+@dataclasses.dataclass(frozen=True)
 class RMSprop(Optimizer):
     """torch.optim.RMSprop equivalent (no momentum/centered variants):
     ``v = alpha*v + (1-alpha)*g^2; p -= lr * g / (sqrt(v) + eps)``."""
@@ -414,6 +459,10 @@ def from_config(name: str, params_dict: Optional[dict] = None) -> Optimizer:
         if "momentum" in p:
             kw["momentum"] = float(p.pop("momentum"))
         return Sgd(**kw)
+    if name_l == "lion":
+        kw.pop("eps", None)
+        p.pop("max_grad_norm", None)
+        return Lion(**kw)
     if name_l == "rmsprop":
         if "alpha" in p:
             kw["alpha"] = float(p.pop("alpha"))

@@ -55,6 +55,72 @@ def test_from_config_names():
     assert optim_mod.from_config("RMSprop", {"lr": 0.1,
                                              "alpha": 0.9}).alpha == 0.9
     assert optim_mod.from_config("Adagrad", {"lr": 0.1}).name == "adagrad"
+    lion = optim_mod.from_config("Lion", {"lr": 3e-4, "betas": [0.95, 0.98],
+                                          "weight_decay": 0.1})
+    assert (lion.name, lion.beta1, lion.beta2,
+            lion.weight_decay) == ("lion", 0.95, 0.98, 0.1)
+
+
+def test_lion_update_rule_closed_form():
+    """One step from zero momentum: u = sign((1-b1)·g) = sign(g), so
+    p1 = p0 - lr·(sign(g) + wd·p0) and m1 = (1-b2)·g — the paper's
+    update, checked exactly."""
+    lr, wd, b1, b2 = 0.01, 0.1, 0.9, 0.99
+    opt = optim_mod.Lion(lr=lr, beta1=b1, beta2=b2, weight_decay=wd)
+    rng = np.random.default_rng(1)
+    p0 = rng.normal(size=(4, 8)).astype(np.float32)
+    g = rng.normal(size=(4, 8)).astype(np.float32)
+    params = {"w": jnp.asarray(p0)}
+    state = opt.init(params)
+    new_p, new_state = opt.update(params, {"w": jnp.asarray(g)}, state)
+    np.testing.assert_allclose(
+        np.asarray(new_p["w"]), p0 - lr * (np.sign(g) + wd * p0),
+        rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(new_state.m["w"]),
+                               (1 - b2) * g, rtol=1e-6, atol=1e-7)
+    assert new_state.v is None
+
+    # sign-update invariance: scaling the gradient leaves the step
+    # unchanged (the momentum differs) — the documented Lion property
+    new_p2, _ = opt.update(params, {"w": jnp.asarray(10.0 * g)}, state)
+    np.testing.assert_allclose(np.asarray(new_p2["w"]),
+                               np.asarray(new_p["w"]), rtol=1e-6)
+
+
+def test_engine_trains_with_lion():
+    from simple_model import SimpleModel, random_dataset
+    model = SimpleModel(16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 16,
+                "optimizer": {"type": "Lion",
+                              "params": {"lr": 3e-4, "betas": [0.9, 0.99],
+                                         "weight_decay": 0.01}},
+                "steps_per_print": 10 ** 6},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    ds = random_dataset(64, 16)
+    losses = []
+    for batch in engine.deepspeed_io(ds):
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_lion_rejected_under_zero():
+    from simple_model import SimpleModel
+    model = SimpleModel(16)
+    with pytest.raises(DeepSpeedConfigError, match="Adam-family"):
+        deepspeed_tpu.initialize(
+            config={"train_batch_size": 16,
+                    "optimizer": {"type": "Lion", "params": {"lr": 3e-4}},
+                    "fp16": {"enabled": True},
+                    "zero_optimization": True,
+                    "steps_per_print": 10 ** 6},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(0)))
 
 
 def test_registry_extension():
