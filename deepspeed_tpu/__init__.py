@@ -1,15 +1,18 @@
 """deepspeed_tpu — a TPU-native training engine with the capabilities of
-DeepSpeed v0.1.0 (and beyond: ZeRO stages 1-2, pipeline GPipe/1F1B,
-sequence/context parallelism, MoE expert parallelism), built on
-JAX / XLA / Pallas / pjit.
+DeepSpeed v0.1.0 (and beyond: ZeRO stages 1-3 including stage-3/FSDP
+parameter partitioning, pipeline GPipe/1F1B, sequence/context parallelism
+two ways — ring attention and Ulysses all-to-all — and MoE expert
+parallelism), built on JAX / XLA / Pallas / pjit.
 
 Public API mirrors the reference (/root/reference/deepspeed/__init__.py:28-169):
 ``initialize(...)`` returns an ``(engine, optimizer, dataloader, lr_scheduler)``
 4-tuple; ``add_config_arguments(parser)`` injects the standard CLI flags.
-Submodules: ``models`` (sharded GPT-2/BERT family), ``tokenization`` +
-``squad`` (wordpiece pipeline), ``metrics``, ``checkpoint`` (incl.
-``load_module_tree``/``init_from_module_tree`` transfer), ``ops``
-(optimizers + Pallas kernels), ``parallel`` (mesh/collectives/pipeline).
+Submodules: ``models`` (sharded GPT-2/BERT family incl. ring/Ulysses
+attention), ``tokenization`` + ``squad`` (wordpiece pipeline),
+``metrics``, ``checkpoint`` (incl. ``load_module_tree``/
+``init_from_module_tree`` transfer), ``ops`` (optimizers incl. Lion +
+Pallas kernels), ``parallel`` (mesh/collectives/pipeline), ``zero3``
+(parameter-partitioning helpers).
 """
 
 __version__ = "0.1.0"
