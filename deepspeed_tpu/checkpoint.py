@@ -77,14 +77,24 @@ class _RestrictedUnpickler(pickle.Unpickler):
         "collections": {"OrderedDict"},
     }
 
+    # the ml_dtypes scalar types a checkpoint can legitimately reference
+    # (dtype classes only — finfo/iinfo and any future public callables
+    # stay forbidden)
+    _SAFE_ML_DTYPES = {
+        "bfloat16", "float8_e3m4", "float8_e4m3", "float8_e4m3b11fnuz",
+        "float8_e4m3fn", "float8_e4m3fnuz", "float8_e5m2",
+        "float8_e5m2fnuz", "float8_e8m0fnu", "float4_e2m1fn",
+        "float6_e2m3fn", "float6_e3m2fn", "int2", "int4", "uint2", "uint4",
+    }
+
     def find_class(self, module, name):
         if module == "numpy.dtypes" or module == "numpy.core.numerictypes" \
                 or module == "numpy._core.numerictypes":
             return super().find_class(module, name)   # dtype classes only
-        if module == "ml_dtypes" and not name.startswith("_"):
-            # bf16/fp8 numpy scalar types: a bf16 params array pickles a
-            # reference to ml_dtypes.bfloat16; the module exposes only
-            # dtype classes, so resolving it is as safe as numpy.dtypes
+        if module == "ml_dtypes" and name in self._SAFE_ML_DTYPES:
+            # bf16/fp8/intN numpy scalar types: a bf16 params array pickles
+            # a reference to ml_dtypes.bfloat16.  Explicit allowlist (like
+            # _SAFE) so new ml_dtypes public callables never widen this
             return super().find_class(module, name)
         if name in self._SAFE.get(module, ()):
             return super().find_class(module, name)

@@ -493,10 +493,19 @@ class DeepSpeedTpuEngine:
         if self.mp_world_size > 1:
             self._zero_state_axes.append((MODEL_AXIS, self.mp_world_size))
         if self.zero_enabled:
-            if self.base_optimizer.name not in ("adam", "adamw"):
+            # stages 1-2 keep the reference's Adam-family guard (the flat
+            # [S, padded] master/moment layout is built for m+v state);
+            # stage 3 updates per-leaf on partitioned shards, so any
+            # elementwise optimizer works — Lion (m-only state) is admitted
+            # there (ADVICE r4; parity pinned in
+            # tests/test_zero3.py::test_zero3_lion_matches_stage0)
+            stage3_ok = ("lion",) if self.config.zero_stage == 3 else ()
+            if self.base_optimizer.name not in ("adam", "adamw") + stage3_ok:
                 raise DeepSpeedConfigError(
-                    f"zero_optimization is only supported for Adam-family "
-                    f"optimizers, got {self.base_optimizer.name!r} "
+                    f"zero_optimization stage {self.config.zero_stage} is "
+                    f"only supported for Adam-family optimizers (Lion is "
+                    f"admitted at stage 3, where the update is per-leaf "
+                    f"elementwise), got {self.base_optimizer.name!r} "
                     f"(reference guard: deepspeed_light.py:450-457)")
             # parameter-parallel sub-groups (reference deepspeed_light.py:
             # 63-77): optimizer state partitions over a SUBSET of size pps
@@ -549,6 +558,30 @@ class DeepSpeedTpuEngine:
             # layer exactly like the full stack — dim 0 is pipe-sharded
             # and zero3_min_dims pins it, so the data axis lands on a
             # weight dim; tests/test_zero3.py::test_zero3_with_pipeline)
+            # Partitioned leaves reduce inside the gather's autodiff
+            # transpose (a compute-dtype psum_scatter BEFORE the /world
+            # division), so the stage-0 reduction envelope knobs cannot
+            # apply to them (ADVICE r4; docs/features.md "ZeRO-3
+            # reduction dtype").  Warn loudly rather than silently
+            # ignoring the config.
+            inert = [k for k, dflt, v in (
+                ("fp32_allreduce", C.FP32_ALLREDUCE_DEFAULT,
+                 self.config.fp32_allreduce),
+                ("prescale_gradients", C.PRESCALE_GRADIENTS_DEFAULT,
+                 self.config.prescale_gradients),
+                ("gradient_predivide_factor",
+                 C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT,
+                 self.config.gradient_predivide_factor)) if v != dflt]
+            if inert:
+                logger.warning(
+                    "zero_optimization.stage=3: %s only affect(s) "
+                    "REPLICATED leaves; partitioned leaves reduce via the "
+                    "gather transpose's compute-dtype (bf16/fp16) "
+                    "psum_scatter before the 1/world division, so fp16 "
+                    "partial sums there can overflow where the prescaled "
+                    "stage-0 path would not (dynamic loss scaling "
+                    "recovers but trajectories can diverge)",
+                    ", ".join(inert))
 
         # -- loss scale state
         if self.config.fp16_enabled:

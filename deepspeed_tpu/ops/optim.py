@@ -303,8 +303,12 @@ class Lion(Optimizer):
     """Lion — EvoLved Sign Momentum (Chen et al. 2023, arXiv:2302.06675).
 
     Beyond-reference breadth: a TPU-popular optimizer with HALF of Adam's
-    state (one momentum, no second moment — pairs with the ZeRO memory
-    story).  Update: ``u = sign(b1·m + (1-b1)·g); p -= lr·(u + wd·p);
+    state (one momentum, no second moment).  Admitted under ZeRO-3, where
+    the update runs per-leaf elementwise on local shards (the flat
+    stage-1/2 layout keeps the reference's Adam-family guard —
+    engine ZeRO guard; parity:
+    tests/test_zero3.py::test_zero3_lion_matches_stage0).
+    Update: ``u = sign(b1·m + (1-b1)·g); p -= lr·(u + wd·p);
     m = b2·m + (1-b2)·g``.  Decay is decoupled (AdamW-style) per the
     paper.  Under fp16 the combined unscale factor divides the gradient
     before both the sign interpolation and the momentum update; note the

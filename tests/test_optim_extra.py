@@ -109,7 +109,10 @@ def test_engine_trains_with_lion():
     assert losses[-1] < losses[0]
 
 
-def test_lion_rejected_under_zero():
+def test_lion_rejected_under_flat_zero():
+    # stages 1-2 keep the flat [S, padded] m+v layout -> Adam-family only;
+    # stage 3 (per-leaf elementwise) admits Lion — parity pinned in
+    # tests/test_zero3.py::test_zero3_lion_matches_stage0
     from simple_model import SimpleModel
     model = SimpleModel(16)
     with pytest.raises(DeepSpeedConfigError, match="Adam-family"):
