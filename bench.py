@@ -113,7 +113,12 @@ def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
     from deepspeed_tpu.parallel.topology import make_mesh
 
     n_chips = jax.device_count()
-    model = BertForPreTraining.from_size(size, max_seq_len=max(seq, 128))
+    over = {}
+    if os.environ.get("BENCH_LAYER_OVERRIDE"):
+        # ablation hook (run_mfu_breakdown): same geometry, fewer layers
+        over["num_layers"] = int(os.environ["BENCH_LAYER_OVERRIDE"])
+    model = BertForPreTraining.from_size(size, max_seq_len=max(seq, 128),
+                                         **over)
     vocab = model.config.vocab_size
 
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -139,7 +144,8 @@ def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
     # masked-positions MLM batch: the standard BERT pretraining format
     # (max_predictions_per_seq=20 at seq 128, the reference recipe's shape —
     # bert-pretraining.md data pipeline)
-    n_pred = int(os.environ.get("BENCH_MAXPRED", "20"))
+    n_pred = int(os.environ.get("BENCH_MAXPRED",
+                                "80" if seq >= 512 else "20"))
     rng = np.random.default_rng(0)
     B = batch_per_chip * n_chips * gas
     ids = rng.integers(0, vocab, size=(B, seq)).astype(np.int32)
@@ -183,17 +189,84 @@ def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
     }
 
 
-def run_pipeline_sweep(steps=4, warmup=2):
-    """pp ∈ {1, 2, 4, ...} GPT-2 throughput sweep at constant global batch:
-    per-chip samples/s, measured pipeline efficiency vs pp=1, and the GPipe
-    theoretical ceiling m/(m+pp-1) (VERDICT r2 #5).  Needs ≥2 devices (run
-    under the virtual CPU mesh on a single-chip host); rows on stderr, one
-    JSON summary on stdout."""
-    import jax
+def _pp_body_tok_flops(hidden, seq):
+    """Fwd matmul FLOPs per token for one transformer layer body."""
+    return 2.0 * 12 * hidden * hidden + 4.0 * seq * hidden
 
-    import deepspeed_tpu
-    from deepspeed_tpu.models import GPT2Pipelined
-    from deepspeed_tpu.parallel.topology import make_mesh
+
+def _pp_head_tok_flops(hidden, vocab):
+    """Fwd matmul FLOPs per token for the vocab head."""
+    return 2.0 * vocab * hidden
+
+
+def _pp_analytic_row(pp, schedule, m, layers, hidden, seq, vocab):
+    """Exact per-device cost model of one optimizer step of the committed
+    schedules (VERDICT r4 weak #1: the virtual-CPU wall-clock sweep was
+    noise; these counts are derived from the programs in
+    parallel/pipeline.py and are deterministic and hardware-independent).
+
+    Units: one "body unit" = one stage body application (layers/pp layers)
+    on one micro-batch; one "head unit" = one head forward on one
+    micro-batch (LN -> vocab logits -> CE sum; its VJP pull costs ~2
+    more).  SPMD means EVERY stage executes every tick's full program —
+    bubble ticks burn the same FLOPs as live ones, and the 1F1B head
+    VJP runs on all stages every tick with all but the last stage masked.
+
+    GPipe (pipeline_apply + scan autodiff): m+pp-1 forward ticks (1 body)
+    + m+pp-1 backward ticks (2 body; residuals saved, no recompute); the
+    head runs OUTSIDE the schedule on the psum-collected [m] outputs
+    through pipe_sharded_loss (each stage takes a 1/pp batch slice) =
+    3·m/pp head units per device.  Activation residency: m+pp-1 saved
+    stage inputs (scan residuals).
+
+    1F1B (_run_1f1b): m+2(pp-1) ticks, each = 1 body forward + a
+    recompute-from-ring VJP (1 forward replay + 2 pull) = 4 body units,
+    PLUS a head forward + head VJP (3 head units) every tick.
+    Activation residency: the min(m, 2pp-1) input ring — the memory win
+    the schedule exists for.
+    """
+    body_tok = _pp_body_tok_flops(hidden, seq)
+    head_tok = _pp_head_tok_flops(hidden, vocab)
+    if pp == 1:
+        ticks, body_units, head_units = m, 3.0 * m, 3.0 * m
+        ppermutes, ring = 0, m
+    elif schedule == "gpipe":
+        ticks = m + pp - 1
+        body_units = 3.0 * ticks            # 1 fwd + 2 bwd per tick
+        head_units = 3.0 * m / pp           # sharded (pipe_sharded_loss)
+        ppermutes = 2 * ticks
+        ring = ticks                        # scan residuals
+    else:                                   # 1f1b
+        ticks = m + 2 * (pp - 1)
+        body_units = 4.0 * ticks            # fwd + recompute + 2 pull
+        head_units = 3.0 * ticks            # head vjp EVERY tick, masked
+        ppermutes = 2 * ticks
+        ring = min(m, 2 * pp - 1)
+    # per-device fwd-FLOPs per step per (micro-batch token): bubbles and
+    # masked head work included — this is what the device EXECUTES
+    flops = (body_units * (layers / pp) * body_tok
+             + head_units * head_tok)
+    return {"pp": pp, "schedule": schedule, "ticks": ticks,
+            "body_units": body_units, "head_units": head_units,
+            "ppermutes_per_step": ppermutes,
+            "activation_ring_slots": ring,
+            "device_flops_per_micro_token": round(flops, 0),
+            "theory_bubble_eff": round(m / (m + pp - 1), 3)}
+
+
+def run_pipeline_sweep(steps=4, warmup=2):
+    """pp ∈ {1, 2, 4, ...} GPT-2 schedule sweep at constant global batch.
+
+    Primary output is ANALYTIC (deterministic tick/FLOP/collective counts
+    from the committed schedule programs — see _pp_analytic_row), with
+    ``analytic_eff_vs_pp1`` = executed-flops(pp=1)/executed-flops(pp) per
+    device.  Optional measured wall-clock (BENCH_PP_MEASURE=1) reports
+    median ± IQR over BENCH_PP_REPEATS repeats and is flagged
+    ``hardware_true`` only on a real TPU mesh — on the virtual CPU mesh
+    all 8 devices share one host core, so wall-time there is contention
+    noise, not schedule cost (the r4 sweep's negative bubble fractions;
+    VERDICT r4 weak #1)."""
+    import jax
 
     n = jax.device_count()
     if n < 2:
@@ -208,17 +281,13 @@ def run_pipeline_sweep(steps=4, warmup=2):
     bpc = int(os.environ.get("BENCH_BATCH", str(m)))
     layers = int(os.environ.get("BENCH_PP_LAYERS", "8"))
     hidden = int(os.environ.get("BENCH_PP_HIDDEN", "256"))
+    vocab = 50257
     if bpc % m:
         raise RuntimeError(
             f"BENCH_BATCH ({bpc}) must be a multiple of BENCH_PP_MICRO "
             f"({m}) so the pp=1 baseline runs (eff_vs_pp1 is relative to "
             f"it)")
     B = bpc * n  # constant global batch across pp configs
-
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, 50257, size=(B, seq)).astype(np.int32)
-    labels = np.roll(toks, -1, axis=1)
-    labels[:, -1] = -1
 
     schedules = [s.strip() for s in
                  os.environ.get("BENCH_PP_SCHEDULES",
@@ -228,17 +297,39 @@ def run_pipeline_sweep(steps=4, warmup=2):
         raise RuntimeError(
             f"BENCH_PP_SCHEDULES entries must be 'gpipe' or '1f1b', "
             f"got {bad or schedules}")
-    rows = []
-    pp = 1
+
+    measure = os.environ.get("BENCH_PP_MEASURE", "0") == "1"
+    repeats = int(os.environ.get("BENCH_PP_REPEATS", "5"))
+    configs, pp = [], 1
     while pp <= n:
-        per_shard = B * pp // n  # batch per (dp) shard
-        if per_shard % m or layers % pp:
-            pp *= 2
-            continue
-        for schedule in (("gpipe",) if pp == 1 else schedules):
+        if (B * pp // n) % m == 0 and layers % pp == 0:
+            for schedule in (("gpipe",) if pp == 1 else schedules):
+                configs.append((pp, schedule))
+        pp *= 2
+
+    rows = [_pp_analytic_row(pp, s, m, layers, hidden, seq, vocab)
+            for pp, s in configs]
+    # per-chip efficiency at constant global batch: a pp-deep dp-shard
+    # processes pp x the per-device batch of pp=1 (mb scales with pp), so
+    # wall ∝ device_flops_per_micro_token x pp
+    base_flops = rows[0]["device_flops_per_micro_token"]
+    for r in rows:
+        r["analytic_eff_vs_pp1"] = round(
+            base_flops / (r["device_flops_per_micro_token"] * r["pp"]), 3)
+
+    if measure:
+        import deepspeed_tpu
+        from deepspeed_tpu.models import GPT2Pipelined
+        from deepspeed_tpu.parallel.topology import make_mesh
+
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, vocab, size=(B, seq)).astype(np.int32)
+        labels = np.roll(toks, -1, axis=1)
+        labels[:, -1] = -1
+        for row, (pp, schedule) in zip(rows, configs):
             model = GPT2Pipelined.from_size(
                 "tiny", num_micro_batches=m, schedule=schedule,
-                vocab_size=50257, max_seq_len=seq,
+                vocab_size=vocab, max_seq_len=seq,
                 num_layers=layers, hidden_size=hidden,
                 num_heads=max(4, hidden // 64))
             engine, _, _, _ = deepspeed_tpu.initialize(
@@ -252,30 +343,52 @@ def run_pipeline_sweep(steps=4, warmup=2):
             for _ in range(warmup):
                 loss = engine.train_batch((toks, labels))
             float(loss)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = engine.train_batch((toks, labels))
-            float(loss)
-            dt = time.perf_counter() - t0
-            per_chip = B * steps / dt / n
-            rows.append({"pp": pp, "schedule": schedule,
-                         "per_chip": round(per_chip, 2),
-                         "theory_eff": round(m / (m + pp - 1), 3)})
-            print(f"pp={pp} {schedule}: {per_chip:.2f} samples/s/chip "
-                  f"(theory ceiling {m}/{m + pp - 1} = "
-                  f"{m / (m + pp - 1):.3f} of pp=1)", file=sys.stderr)
-        pp *= 2
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = engine.train_batch((toks, labels))
+                float(loss)
+                times.append((time.perf_counter() - t0) / steps)
+            q1, med, q3 = np.percentile(times, [25, 50, 75])
+            row["measured_ms_per_step"] = round(med * 1000, 1)
+            row["measured_iqr_ms"] = round((q3 - q1) * 1000, 1)
+            row["measured_per_chip"] = round(B / med / n, 2)
+            print(f"pp={pp} {schedule}: {med*1000:.0f} ms/step "
+                  f"(IQR {1000*(q3-q1):.0f} ms)", file=sys.stderr)
 
-    base = rows[0]["per_chip"]
-    for r in rows:
-        r["eff_vs_pp1"] = round(r["per_chip"] / base, 3)
-        r["bubble_fraction"] = round(1.0 - r["per_chip"] / base, 3)
-    out = {"metric": "gpt2_pipeline_sweep", "unit": "samples/s/chip",
-           "num_micro_batches": m, "rows": rows}
-    if jax.devices()[0].platform != "tpu":
-        # virtual CPU devices share one host: per-chip numbers measure the
-        # schedule's program structure, not ICI/bubble costs
-        out["note"] = "virtual CPU mesh; per-chip figures not hardware-true"
+    pp_max = max(pp for pp, _ in configs)
+    head_ratio = _pp_head_tok_flops(hidden, vocab) / (
+        _pp_body_tok_flops(hidden, seq) * (layers / pp_max))
+    gpipe_max = [r for r in rows if r["pp"] == pp_max
+                 and r["schedule"] == "gpipe"]
+    f1b_max = [r for r in rows if r["pp"] == pp_max
+               and r["schedule"] == "1f1b"]
+    ratio = (gpipe_max[0]["analytic_eff_vs_pp1"]
+             / f1b_max[0]["analytic_eff_vs_pp1"]
+             if gpipe_max and f1b_max else float("nan"))
+    out = {"metric": "gpt2_pipeline_sweep",
+           "unit": "analytic per-device cost model (+ optional timing)",
+           "num_micro_batches": m, "layers": layers, "hidden": hidden,
+           "hardware_true": bool(measure
+                                 and jax.devices()[0].platform == "tpu"),
+           "rows": rows,
+           "note": ("1F1B trades compute for memory BY DESIGN: 4 body "
+                    "units/tick (activation recompute) over m+2(pp-1) "
+                    "ticks vs GPipe's 3 over m+pp-1, and its in-schedule "
+                    "head VJP runs REPLICATED on every stage every tick "
+                    "(SPMD; all but the last stage masked) while GPipe's "
+                    "off-schedule head is 1/pp-sharded.  At this sweep's "
+                    "toy shape the head is %.0fx the per-stage body at "
+                    "pp=%d, so the analytic gpipe/1f1b ratio there is "
+                    "~%.0fx — the r4 'pp=8 collapse' reproduced from "
+                    "first principles: structural head domination at a "
+                    "toy shape, not a scheduler bug (virtual-mesh timing "
+                    "noise added the rest).  1F1B's win is the "
+                    "min(m,2pp-1) activation ring vs GPipe's m+pp-1 scan "
+                    "residuals (activation_ring_slots); prefer it when "
+                    "activations, not FLOPs, bound the config."
+                    % (head_ratio, pp_max, ratio))}
     _emit(out)
     return 0
 
@@ -339,6 +452,389 @@ def run_attention_sweep(steps=10, warmup=3):
     return 0
 
 
+def run_mfu_breakdown():
+    """Account for the headline step's chip time by ENGINE-LEVEL ablation
+    (VERDICT r4 weak #2: MFU 0.554 with no committed breakdown).
+
+    Per-op microbenches are not trustworthy on this rig: the axon
+    platform's ``block_until_ready`` returns before the chip finishes
+    (a 1.1 TFLOP matmul "completes" in 0.07 ms) and per-dispatch tunnel
+    overhead inflates chained small ops ~50x — only the fenced
+    ``train_batch`` + final-loss-read methodology gives real times.  So
+    every number here IS a full fenced engine run, and components come
+    from differencing configs:
+
+      base           L=24 layers, gas=G, maxpred=20   (headline shape)
+      half_layers    L=12                             -> per-layer cost
+      double_gas     gas=2G                           -> per-micro vs fixed
+      maxpred80      maxpred=80                       -> MLM-head cost
+      seq256         seq=256, mb halved (same tokens) -> attention growth
+
+    Derived per-optimizer-step seconds:
+      body+attn+ln (24 layers) = 2 x (base - half_layers)
+      per-step fixed (LAMB update + dispatch) = base - G x per_micro
+      mlm head (20 preds) = (maxpred80 - base) / 3
+      attention(seq128 portion): seq256 doubles attention score/value
+        FLOPs per token but keeps matmul FLOPs constant ->
+        attn ~= (seq256 - base) adjusted by the remat replay share
+      residual = base - (sum of attributed components) — reported, not
+        hidden (VERDICT asks >= 90% accounted).
+    One JSON line."""
+    import gc
+
+    G = int(os.environ.get("BENCH_GAS", "12"))
+    mb = int(os.environ.get("BENCH_BATCH", "24"))
+    steps = int(os.environ.get("BENCH_STEPS", "6"))
+
+    def step_s(seq=128, layers=None, gas=None, maxpred=None, batch=None):
+        over = {}
+        if layers is not None:
+            os.environ["BENCH_LAYER_OVERRIDE"] = str(layers)
+        if maxpred is not None:
+            os.environ["BENCH_MAXPRED"] = str(maxpred)
+        try:
+            res = run_config("large", seq, batch or mb, steps, "selective",
+                             gas=gas or G)
+        finally:
+            os.environ.pop("BENCH_LAYER_OVERRIDE", None)
+            os.environ.pop("BENCH_MAXPRED", None)
+        gc.collect()
+        B = (batch or mb) * (gas or G)
+        return B / res["per_chip"], res
+
+    base_s, base_res = step_s()
+    half_layers_s, _ = step_s(layers=12)
+    double_gas_s, _ = step_s(gas=2 * G)
+    maxpred80_s, _ = step_s(maxpred=80)
+    seq256_s, _ = step_s(seq=256, batch=mb // 2)
+
+    per_micro = (double_gas_s - base_s) / G
+    fixed = base_s - G * per_micro                 # LAMB + per-step misc
+    body_attn_ln = 2.0 * (base_s - half_layers_s)  # all 24 layers, / step
+    head20 = (maxpred80_s - base_s) / 3.0
+    # seq256 at half mb: same matmul FLOPs/step, attention score/value
+    # FLOPs double, remat replays them again in the backward
+    attn_total = seq256_s - base_s                 # extra attention = 1x
+    embed_and_misc = base_s - body_attn_ln - head20 - fixed
+
+    comps = {
+        "body_24_layers_matmul_attn_ln": round(body_attn_ln, 4),
+        "attention_portion_of_body": round(attn_total, 4),
+        "mlm_head_20_preds": round(head20, 4),
+        "per_step_fixed_lamb_dispatch": round(fixed, 4),
+        "embedding_residual": round(embed_and_misc, 4),
+    }
+    attributed = body_attn_ln + head20 + fixed
+    accounted_pct = attributed / base_s * 100
+    _emit({"metric": "bert_large_seq128_mfu_breakdown",
+           "value": round(accounted_pct, 1),
+           "unit": "% of measured step attributed by engine ablations "
+                   "(residual reported separately)",
+           "measured_step_s": round(base_s, 4),
+           "gas": G, "batch_per_chip": mb,
+           "per_chip": round(base_res["per_chip"], 2),
+           "mfu": round(base_res["mfu"], 4),
+           "ablation_step_s": {
+               "base": round(base_s, 4),
+               "half_layers": round(half_layers_s, 4),
+               "double_gas": round(double_gas_s, 4),
+               "maxpred80": round(maxpred80_s, 4),
+               "seq256_halfbatch": round(seq256_s, 4)},
+           "components_s": comps,
+           "components_pct": {k: round(v / base_s * 100, 1)
+                              for k, v in comps.items()}})
+    return 0
+
+
+def run_data_bench(steps=4, warmup=2):
+    """Real-data input-path throughput at the headline config (VERDICT r4
+    weak #4): REAL text (the repo's own docs) → wordpiece tokenize →
+    masked-LM arrays → FileDataset on disk → memmap + native row-gather →
+    producer-thread collation + double-buffered device placement →
+    engine.train_batch.  Compared against the synthetic in-memory batch
+    the headline uses.  Done-bar: within 3% of synthetic."""
+    import gc
+    import glob
+    import shutil
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu import tokenization as tok
+    from deepspeed_tpu.data import DeepSpeedDataLoader, FileDataset
+    from deepspeed_tpu.models import BertForPreTraining
+    from deepspeed_tpu.parallel.topology import make_mesh
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    mb = int(os.environ.get("BENCH_BATCH", "24" if on_tpu else "4"))
+    gas = int(os.environ.get("BENCH_GAS", "48" if on_tpu else "2"))
+    seq, n_pred = 128, 20
+    size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "tiny")
+
+    # -- synthetic leg (the headline methodology)
+    res = run_config(size, seq, mb, steps, "selective", gas=gas,
+                     warmup=warmup)
+    synth = res["per_chip"]
+    gc.collect()
+
+    # -- build the on-disk corpus from real repo text
+    texts = []
+    for path in sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "docs", "*.md"))):
+        with open(path) as f:
+            texts.append(f.read())
+    corpus = "\n".join(texts)
+    words = sorted(set(w for w in corpus.split() if w))
+    vocab = tok.Vocab(list(tok.SPECIAL_TOKENS) + words)
+    tokenizer = tok.BertTokenizer(vocab)
+    B = mb * jax.device_count() * gas
+    need = (steps + warmup) * B + B
+    reps = []
+    n_have = 0
+    while n_have < need * (seq - 2):        # rough token budget
+        reps.append(corpus)
+        n_have += len(corpus.split())       # >= 1 token per word
+    fields = tok.build_mlm_arrays(reps, tokenizer, seq_len=seq,
+                                  max_predictions=n_pred,
+                                  n_samples=need)
+    d = tempfile.mkdtemp(prefix="dstpu_mlm_")
+    FileDataset.save(d, **fields)
+
+    # -- file-backed leg: fresh engine (the synthetic one was freed),
+    #    loader streams from disk with producer-side device placement.
+    #    The MODEL must match the synthetic leg exactly (standard vocab;
+    #    the small test vocab's ids index into it fine)
+    model = BertForPreTraining.from_size(size, max_seq_len=seq)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": B,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "Lamb",
+                              "params": {"lr": 4e-3, "max_coeff": 0.5,
+                                         "min_coeff": 0.08}},
+                "bf16": {"enabled": True},
+                "activation_checkpointing": {"enabled": True,
+                                             "policy": "selective"},
+                "steps_per_print": 10 ** 9},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=make_mesh(model_parallel_size=1))
+    loader = DeepSpeedDataLoader(FileDataset(d), batch_size=B,
+                                 mesh=engine.mesh, num_workers=1,
+                                 prefetch_depth=2, device_prefetch=True)
+    it = iter(loader)
+    for _ in range(warmup):
+        loss = engine.train_batch(next(it))
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(next(it))
+    last = float(loss)
+    dt = time.perf_counter() - t0
+    per_chip = B * steps / dt / jax.device_count()
+    shutil.rmtree(d, ignore_errors=True)
+    if not np.isfinite(last):
+        raise RuntimeError(f"real-data bench loss not finite: {last}")
+
+    _emit({"metric": "bert_%s_seq%d_realdata_vs_synthetic" % (size, seq),
+           "value": round(per_chip / synth, 4),
+           "unit": "x of synthetic throughput (1.0 = no input bottleneck)",
+           "realdata_per_chip": round(per_chip, 2),
+           "synthetic_per_chip": round(synth, 2),
+           "n_samples_on_disk": int(fields["input_ids"].shape[0]),
+           "vocab": len(vocab)})
+    return 0
+
+
+def run_opt_bench(repeats=30):
+    """Optimizer-kernel microbench (VERDICT r4 weak #5 / item 8): the
+    Pallas LAMB/Adam kernels vs XLA's fused update, ON CHIP, in the two
+    layouts the engine actually runs — the per-leaf BERT-large tree and
+    the single ZeRO-style flat fp32 buffer (for Adam the flat buffer is
+    one leaf, so the Pallas row IS the batched flat-buffer kernel).  One
+    JSON line; the committed artifact decides should_use_pallas."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import BertForPreTraining
+    from deepspeed_tpu.ops import optim as optim_mod
+
+    model = BertForPreTraining.from_size("large", max_seq_len=128)
+    params = jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32),
+        model.init_params(jax.random.PRNGKey(0)))
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 1e-4, jnp.float32), params)
+    n = _count_params(params)
+
+    def timed(opt, p, g, s):
+        """Chained executions + one readback: block_until_ready does not
+        fence on the axon platform (see run_mfu_breakdown.timed)."""
+        def step(eps, p, g, s):
+            p2 = jax.tree_util.tree_map(lambda x: x + eps, p)
+            new_p, _ = opt.update(p2, g, s)
+            return sum(jnp.sum(l).astype(jnp.float32) * 1e-9
+                       for l in jax.tree_util.tree_leaves(new_p))
+        upd = jax.jit(step)
+        float(upd(jnp.zeros(()), p, g, s))
+        acc = jnp.zeros(())
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            acc = upd(acc * 1e-30, p, g, s)
+        float(acc)
+        return (time.perf_counter() - t0) / repeats
+
+    import gc
+
+    rows = []
+    for layout in ("per_leaf_tree", "flat_buffer"):
+        if layout == "per_leaf_tree":
+            p, g = params, grads
+        else:
+            # free the tree layout first — chip HBM holds only one layout
+            # (+ its optimizer state) at a time
+            params = grads = None
+            gc.collect()
+            p = zero_flat_like(model.init_params(jax.random.PRNGKey(0)))
+            g = jnp.full_like(p, 1e-4)
+        for name, mk in (("lamb", lambda up: optim_mod.Lamb(
+                              lr=4e-3, use_pallas=up)),
+                         ("adam", lambda up: optim_mod.Adam(
+                              lr=1e-4, use_pallas=up))):
+            if layout == "flat_buffer" and name == "lamb":
+                # a flat-buffer LAMB computes ONE global trust ratio —
+                # different numerics from the per-leaf reference; the
+                # engine never runs it, so don't bench it
+                continue
+            res = {}
+            for mode, up in (("xla", False), ("pallas", True)):
+                opt = mk(up)
+                state = opt.init(p)
+                res[mode] = timed(opt, p, g, state)
+                state = None
+                gc.collect()
+            rows.append({"layout": layout, "opt": name,
+                         "xla_ms": round(res["xla"] * 1000, 3),
+                         "pallas_ms": round(res["pallas"] * 1000, 3),
+                         "pallas_vs_xla": round(
+                             res["xla"] / res["pallas"], 3)})
+            print(f"{layout} {name}: xla {res['xla']*1e3:.2f} ms, "
+                  f"pallas {res['pallas']*1e3:.2f} ms", file=sys.stderr)
+        p = g = None
+        gc.collect()
+    _emit({"metric": "optimizer_kernel_microbench",
+           "unit": "ms per update, %d params" % n,
+           "n_params": n, "rows": rows})
+    return 0
+
+
+def zero_flat_like(params):
+    """One fp32 flat buffer with the tree's total (128-lane padded) size —
+    the ZeRO stage-1/2 master layout."""
+    import jax.numpy as jnp
+    n = _count_params(params)
+    padded = ((n + 127) // 128) * 128
+    return jnp.zeros((padded,), jnp.float32) + 1e-2
+
+
+def run_ckpt_bench(tmpdir=None):
+    """Checkpoint save-stall measurement (VERDICT r4 weak #3): BERT-large
+    (the headline model) through engine.save_checkpoint in sync and async
+    modes.  Reports the training stall of each — for async that is the
+    device→host snapshot only; the container writes overlap the next
+    steps — plus restore time and a resume-parity check.  One JSON line."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertForPreTraining
+
+    size = os.environ.get("BENCH_SIZE",
+                          "large" if jax.default_backend() == "tpu"
+                          else "tiny")
+    model = BertForPreTraining.from_size(size, max_seq_len=128)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 8, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    n_params = _count_params(engine.params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.config.vocab_size, size=(8, 128))
+    positions = np.stack([rng.choice(128, size=20, replace=False)
+                          for _ in range(8)]).astype(np.int32)
+    batch = (ids.astype(np.int32), np.ones((8, 128), np.int32),
+             np.zeros((8, 128), np.int32), positions,
+             np.take_along_axis(ids, positions, axis=1).astype(np.int32),
+             np.ones((8, 20), np.float32))
+    float(engine.train_batch(batch))      # compile + settle
+
+    d = tmpdir or tempfile.mkdtemp(prefix="dstpu_ckpt_bench_")
+    rows = {}
+    t0 = time.perf_counter()
+    float(engine.train_batch(batch))
+    rows["baseline_step_s"] = round(time.perf_counter() - t0, 3)
+
+    # COLD sync save: the step above replaced every device array, so this
+    # pays device→host transfer AND the container write
+    t0 = time.perf_counter()
+    engine.save_checkpoint(d, tag="sync")
+    rows["sync_save_stall_s"] = round(time.perf_counter() - t0, 3)
+    # WARM sync save (no step in between → jax host-copy caches hit):
+    # isolates the container write + disk cost
+    t0 = time.perf_counter()
+    engine.save_checkpoint(d, tag="sync")
+    rows["container_write_s"] = round(time.perf_counter() - t0, 3)
+    rows["device_to_host_s"] = round(
+        rows["sync_save_stall_s"] - rows["container_write_s"], 3)
+
+    # COLD async save: a fresh step invalidates the caches, so this stall
+    # is the honest steady-state one — the device→host snapshot; the
+    # container write drains on the background thread under the next step
+    float(engine.train_batch(batch))
+    t0 = time.perf_counter()
+    engine.save_checkpoint(d, tag="async", async_save=True)
+    rows["async_save_stall_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    loss_after = float(engine.train_batch(batch))
+    rows["overlapped_step_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    engine.checkpoint_wait()
+    rows["async_drain_s"] = round(time.perf_counter() - t0, 3)
+
+    t0 = time.perf_counter()
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 8, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(1)))
+    e2.load_checkpoint(d, tag="async")
+    rows["restore_s"] = round(time.perf_counter() - t0, 3)
+    parity = abs(float(e2.train_batch(batch)) - loss_after)
+    if not tmpdir:
+        shutil.rmtree(d, ignore_errors=True)
+
+    state_gb = n_params * (2 + 4 + 4 + 4) / 2 ** 30  # bf16 p + fp32 m,mo
+    mbps = state_gb * 1024 / max(rows["device_to_host_s"], 1e-3)
+    _emit({"metric": "checkpoint_save_stall",
+           "value": rows["async_save_stall_s"], "unit": "s (async stall)",
+           "n_params": n_params, "state_gb": round(state_gb, 2),
+           "device_to_host_mb_per_s": round(mbps, 1),
+           "note": ("async stall = device->host snapshot only (the "
+                    "container write drains on the writer thread).  On "
+                    "this rig the chip is reached through the axon "
+                    "tunnel at ~%.0f MB/s, which dominates; a real "
+                    "TPU-VM host does GB/s DMA, putting the same "
+                    "snapshot in low seconds" % mbps),
+           "resume_loss_delta": round(parity, 6), **rows})
+    return 0
+
+
 def main():
     # A wedged device tunnel makes the first jax.devices() hang FOREVER
     # (observed failure mode: the axon relay listener disappears and every
@@ -378,6 +874,14 @@ def main():
     if os.environ.get("BENCH_PP_SWEEP", "0") == "1":
         return run_pipeline_sweep(
             steps=int(os.environ.get("BENCH_STEPS", "4")))
+    if os.environ.get("BENCH_CKPT", "0") == "1":
+        return run_ckpt_bench()
+    if os.environ.get("BENCH_MFU_BREAKDOWN", "0") == "1":
+        return run_mfu_breakdown()
+    if os.environ.get("BENCH_OPT", "0") == "1":
+        return run_opt_bench()
+    if os.environ.get("BENCH_DATA", "0") == "1":
+        return run_data_bench()
     if os.environ.get("BENCH_ATTN_SWEEP", "0") == "1":
         return run_attention_sweep(
             steps=int(os.environ.get("BENCH_STEPS", "10")))
@@ -394,8 +898,14 @@ def main():
     # (bert-pretraining.md 16K-64K: 24 x 48 x 32 chips = 36.9K).
     # remat=False fails to compile at any batch (score tensors exceed
     # HBM without the replay); full remat peaks lower end-to-end.
+    # seq512 defaults (r5 sweep): micro-batch 6 x gas 48 with the streaming
+    # kernel (auto at >= 512 non-causal now) = 84.8 samples/s/chip; larger
+    # micro-batches spill (b=8 collapsed to 43.5).  The recipe-faithful
+    # 256-samples/chip/step config (b=8 x gas=32, bert-pretraining.md
+    # phase 2) measures within 1% of the optimum — WALLCLOCK.md uses it.
+    seq512 = seq >= 512
     batch_per_chip = int(os.environ.get(
-        "BENCH_BATCH", "24" if on_tpu else "8"))
+        "BENCH_BATCH", ("6" if seq512 else "24") if on_tpu else "8"))
     steps = int(os.environ.get("BENCH_STEPS", "8" if on_tpu else "4"))
     gas = int(os.environ.get("BENCH_GAS", "48" if on_tpu else "1"))
     remat_env = os.environ.get("BENCH_REMAT", "selective")

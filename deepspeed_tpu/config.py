@@ -113,6 +113,13 @@ class DeepSpeedConfig:
             pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(
             pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        # beyond-reference: background checkpoint writes (the stall is the
+        # device→host snapshot only; see checkpoint.save_checkpoint)
+        ckpt_sec = pd.get("checkpoint", {}) or {}
+        if not isinstance(ckpt_sec, dict):
+            raise DeepSpeedConfigError(
+                f"'checkpoint' must be a JSON object, got {ckpt_sec!r}")
+        self.checkpoint_async_save = bool(ckpt_sec.get("async_save", False))
         self.pipeline_parallel_size = get_scalar_param(
             pd, C.PIPELINE_PARALLEL_SIZE, C.PIPELINE_PARALLEL_SIZE_DEFAULT)
         self.pipeline_schedule = get_scalar_param(

@@ -1077,7 +1077,11 @@ class DeepSpeedTpuEngine:
             collate_fn=collate_fn or self.collate_fn,
             tput_timer=self.tput_timer if route == C.ROUTE_TRAIN else None,
             seed=self.seed,
-            num_workers=int(num_local_io_workers))
+            num_workers=int(num_local_io_workers),
+            # engine-created loaders double-buffer the host->device copy
+            # on the producer thread; direct DeepSpeedDataLoader users
+            # keep host-numpy batches unless they opt in
+            device_prefetch=True)
 
     # --------------------------------------------------------------- forward
 
@@ -2104,11 +2108,22 @@ class DeepSpeedTpuEngine:
 
     # ---------------------------------------------------------- checkpointing
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None):
-        """reference deepspeed_light.py:1048-1114"""
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        async_save=None):
+        """reference deepspeed_light.py:1048-1114.  ``async_save=True``
+        (or the ``checkpoint.async_save`` config key) returns after the
+        device→host snapshot; the file writes happen on a background
+        thread — call :meth:`checkpoint_wait` to block until durable."""
         from deepspeed_tpu import checkpoint as ckpt_mod
         return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
-                                        client_state=client_state)
+                                        client_state=client_state,
+                                        async_save=async_save)
+
+    def checkpoint_wait(self):
+        """Block until every queued async checkpoint write is on disk;
+        re-raises the first background write failure."""
+        from deepspeed_tpu import checkpoint as ckpt_mod
+        ckpt_mod.ASYNC_SAVER.wait()
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True):

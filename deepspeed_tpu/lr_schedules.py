@@ -482,10 +482,64 @@ class ExponentialLR(_BaseLRsSchedule):
                 for base in self.base_lrs]
 
 
+class WarmupDecayExp:
+    """The bing_bert 16K-batch recipe's ``warmup_linear_decay_exp``
+    schedule (reference docs/_tutorials/bert-pretraining.md:297): linear
+    warmup from 0 to ``lr`` over ``warmup_proportion * total_steps``
+    steps, then exponential decay ``lr * decay_rate^(step/decay_step)``.
+    Constructor-arg spellings follow the published recipe table
+    (warmup 0.02/0.01, decay_rate 0.90/0.70, decay_step 1000)."""
+
+    def __init__(self, optimizer, lr: float = 4e-3,
+                 total_steps: int = 187000,
+                 warmup_proportion: float = 0.02,
+                 decay_rate: float = 0.90, decay_step: int = 1000,
+                 last_batch_iteration: int = -1):
+        self.optimizer = get_param_groups_holder(optimizer)
+        self.lr = lr
+        self.warmup_steps = max(1, int(total_steps * warmup_proportion))
+        self.decay_rate = decay_rate
+        self.decay_step = decay_step
+        self.last_batch_iteration = last_batch_iteration
+        self._last_lr = [g.get("lr", 0.0)
+                         for g in self.optimizer.param_groups]
+
+    def get_lr(self):
+        it = self.last_batch_iteration
+        if it < self.warmup_steps:
+            lr = self.lr * (it + 1) / self.warmup_steps
+        else:
+            lr = self.lr * (self.decay_rate
+                            ** ((it - self.warmup_steps)
+                                / self.decay_step))
+        return [lr for _ in self.optimizer.param_groups]
+
+    def get_last_lr(self):
+        return self._last_lr
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        lrs = self.get_lr()
+        for group, lr in zip(self.optimizer.param_groups, lrs):
+            group["lr"] = lr
+        self._last_lr = list(lrs)
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
 SCHEDULES = {
     LR_RANGE_TEST: LRRangeTest,
     ONE_CYCLE: OneCycle,
     WARMUP_LR: WarmupLR,
+    # the bing_bert recipe schedule (WALLCLOCK.md phase table)
+    "warmup_linear_decay_exp": WarmupDecayExp,
+    "WarmupDecayExp": WarmupDecayExp,
     # torch-name fallthrough registry (reference deepspeed_light.py:351-354)
     "CosineAnnealingLR": CosineAnnealingLR,
     "StepLR": StepLR,

@@ -59,14 +59,17 @@ from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 #   4. the v5e-measured defaults
 # `ops.pallas_attention.calibrate_stream_threshold()` measures the
 # crossover on the attached chip and prints the env pin to persist.
-STREAM_AUTO_MIN = 1024            # non-causal default (v5e-measured)
+STREAM_AUTO_MIN = 1024            # non-causal default (conservative)
 STREAM_AUTO_MIN_CAUSAL = 512      # causal default (v5e end-to-end sweep)
 #: measured per device kind as (causal_min, noncausal_min); extend as
 #: sweeps run on new generations
 #: (BENCH_ATTN_SWEEP=1 BENCH_SEQ=<n> python bench.py)
+#: v5e non-causal: XLA wins at 128 (0.92x r4 sweep) but the kernel wins
+#: 1.17x at 512 (BERT-large seq512 84.8 vs 72.3 samples/s/chip, r5) —
+#: threshold 512 is measured at both ends
 STREAM_AUTO_MIN_BY_KIND = {
-    "TPU v5 lite": (512, 1024),
-    "TPU v5e": (512, 1024),
+    "TPU v5 lite": (512, 512),
+    "TPU v5e": (512, 512),
 }
 
 

@@ -315,3 +315,80 @@ def train_wordpiece(texts: Iterable[str], vocab_size: int,
                 pair_count[(pa, pb)] += f
             words[w] = out
     return Vocab(vocab[:vocab_size] if len(vocab) > vocab_size else vocab)
+
+
+# --------------------------------------------------------- MLM pretrain data
+
+def build_mlm_arrays(texts: Iterable[str], tokenizer: BertTokenizer,
+                     seq_len: int = 128, max_predictions: int = 20,
+                     masked_lm_prob: float = 0.15, seed: int = 0,
+                     n_samples: int = None):
+    """Pre-tokenized BERT masked-LM pretraining arrays from raw text — the
+    bing_bert data-pipeline analog (reference `bert-pretraining.md` data
+    section), producing exactly the 6-field batch format
+    ``BertForPreTraining`` consumes:
+
+    ``(input_ids, input_mask, token_type_ids, masked_positions,
+    masked_ids, masked_weights)``, each ``[N, ...]`` int32/float32.
+
+    Documents tokenize once, pack greedily into ``seq_len``-2 windows
+    ([CLS] ... [SEP]), and mask with the published 80/10/10 recipe (mask /
+    random / keep) at ``masked_lm_prob`` capped at ``max_predictions``.
+    Save with ``deepspeed_tpu.data.FileDataset.save(dir, **fields)`` for
+    the memmap-backed file path."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    cls_id, sep_id = tokenizer.cls_id, tokenizer.sep_id
+    mask_id = tokenizer.vocab.id(MASK_TOKEN)
+    vocab_size = len(tokenizer.vocab)
+
+    # tokenize + pack
+    body = seq_len - 2
+    stream: List[int] = []
+    windows = []
+    for text in texts:
+        ids = tokenizer.encode(text)
+        stream.extend(ids)
+        while len(stream) >= body:
+            windows.append(stream[:body])
+            stream = stream[body:]
+            if n_samples is not None and len(windows) >= n_samples:
+                break
+        if n_samples is not None and len(windows) >= n_samples:
+            break
+    if stream and (n_samples is None or len(windows) < n_samples):
+        windows.append(stream)
+
+    N = len(windows)
+    input_ids = np.zeros((N, seq_len), np.int32)
+    input_mask = np.zeros((N, seq_len), np.int32)
+    token_type = np.zeros((N, seq_len), np.int32)
+    positions = np.zeros((N, max_predictions), np.int32)
+    masked_ids = np.zeros((N, max_predictions), np.int32)
+    weights = np.zeros((N, max_predictions), np.float32)
+
+    for i, win in enumerate(windows):
+        toks = [cls_id] + list(win) + [sep_id]
+        L = len(toks)
+        input_ids[i, :L] = toks
+        input_mask[i, :L] = 1
+        # candidate positions exclude [CLS]/[SEP]
+        cand = np.arange(1, L - 1)
+        n_pred = min(max_predictions,
+                     max(1, int(round(len(cand) * masked_lm_prob))))
+        picked = rng.choice(cand, size=min(n_pred, len(cand)),
+                            replace=False)
+        picked.sort()
+        for j, pos in enumerate(picked):
+            positions[i, j] = pos
+            masked_ids[i, j] = input_ids[i, pos]
+            weights[i, j] = 1.0
+            r = rng.random()
+            if r < 0.8:
+                input_ids[i, pos] = mask_id
+            elif r < 0.9:
+                input_ids[i, pos] = rng.integers(0, vocab_size)
+            # else: keep the original token
+    return {"input_ids": input_ids, "input_mask": input_mask,
+            "token_type_ids": token_type, "masked_positions": positions,
+            "masked_ids": masked_ids, "masked_weights": weights}

@@ -15,6 +15,28 @@ cd "$(dirname "$0")"
 echo "== headline (BERT-large seq128) =="
 BENCH_OUT=bench_headline.json python bench.py
 
+echo "== headline phase-2 (BERT-large seq512, streaming kernel auto) =="
+BENCH_SEQ=512 BENCH_OUT=bench_headline_seq512.json python bench.py
+
+echo "== recipe-faithful legs (256 samples/chip/step = 16K batch / 64"
+echo "   chips — the WALLCLOCK.md projection inputs) =="
+BENCH_BATCH=32 BENCH_GAS=8 BENCH_STEPS=16 \
+    BENCH_OUT=bench_headline_recipe128.json python bench.py
+BENCH_SEQ=512 BENCH_BATCH=8 BENCH_GAS=32 \
+    BENCH_OUT=bench_headline_recipe512.json python bench.py
+
+echo "== checkpoint save-stall (sync vs async writer) =="
+BENCH_CKPT=1 BENCH_OUT=bench_ckpt.json python bench.py
+
+echo "== MFU breakdown (engine-level ablations) =="
+BENCH_MFU_BREAKDOWN=1 BENCH_OUT=bench_mfu_breakdown.json python bench.py
+
+echo "== optimizer kernel microbench (pallas vs xla) =="
+BENCH_OPT=1 BENCH_OUT=bench_opt.json python bench.py
+
+echo "== real-data input path vs synthetic =="
+BENCH_DATA=1 BENCH_OUT=bench_data.json python bench.py
+
 echo "== attention kernel sweep =="
 for SEQ in 128 512 1024 2048; do
     BENCH_ATTN_SWEEP=1 BENCH_SEQ=$SEQ BENCH_OUT=bench_attn_seq${SEQ}.json \

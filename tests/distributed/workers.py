@@ -321,7 +321,7 @@ def zero_mp_ckpt_roles():
 def zero3_ckpt_resume():
     """ZeRO stage 3 across real processes: parameters/masters/moments
     persist data-sharded over a 2-process mesh, the save gathers
-    data-sharded leaves across hosts (checkpoint._host_full), and a fresh
+    per-process data-axis shard files (shard-native stage 3), and a fresh
     engine resumes to the unbroken trajectory."""
     from deepspeed_tpu.models import GPT2
 
@@ -361,11 +361,15 @@ def zero3_ckpt_resume():
     assert not qkv.is_fully_addressable
     saver.save_checkpoint(ckdir, tag="z3")
 
-    # stage-3 layout: optimizer state inline, NO zero_pp_rank_* shards
+    # stage-3 shard-native layout: one zero3_dp_rank_* file per dp rank
+    # (each written by ITS OWN process — nothing gathered), markers in the
+    # model file, NO zero_pp_rank_* flat shards
     if jax.process_index() == 0:
         files = sorted(os.listdir(os.path.join(ckdir, "z3")))
         assert "mp_rank_00_model_states.pt" in files, files
         assert not any(f.startswith("zero_pp_rank") for f in files), files
+        z3_files = [f for f in files if f.startswith("zero3_dp_rank_")]
+        assert len(z3_files) == 2, files
     _barrier("z3_layout_checked")
 
     resumed = make_engine()

@@ -80,3 +80,78 @@ def test_custom_collate_fn():
         ds, batch_size=4,
         collate_fn=lambda samples: {"n": len(samples)})
     assert next(iter(dl)) == {"n": 4}
+
+
+def test_file_dataset_roundtrip(tmp_path):
+    from deepspeed_tpu.data import FileDataset
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 100, size=(32, 16)).astype(np.int32)
+    w = rng.normal(size=(32, 4)).astype(np.float32)
+    d = FileDataset.save(str(tmp_path / "ds"), ids=ids, w=w)
+    fds = FileDataset(d)
+    assert len(fds) == 32
+    a, b = fds[5]
+    np.testing.assert_array_equal(a, ids[5])
+    np.testing.assert_array_equal(b, w[5])
+    # the collate fast path streams through the native gather
+    ga, gb = fds.collate_gather(np.array([3, 1, 2]))
+    np.testing.assert_array_equal(ga, ids[[3, 1, 2]])
+    np.testing.assert_array_equal(gb, w[[3, 1, 2]])
+    # memmap-backed: the big fields are not materialised at open
+    assert isinstance(fds.arrays[0], np.memmap)
+
+
+def test_file_dataset_through_loader(tmp_path):
+    from deepspeed_tpu.data import FileDataset
+    ids = np.arange(64, dtype=np.int32).reshape(16, 4)
+    d = FileDataset.save(str(tmp_path / "ds"), ids=ids)
+    dl = DeepSpeedDataLoader(FileDataset(d), batch_size=4, route="eval",
+                             num_workers=1)
+    got = np.concatenate(list(dl))
+    np.testing.assert_array_equal(got, ids)
+
+
+def test_device_prefetch_places_on_producer():
+    # with device_prefetch the yielded leaves are already sharded
+    # jax.Arrays (the host->device copy happened on the producer thread)
+    import jax
+    from jax.sharding import Mesh
+
+    ds, _, _ = make_ds()
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1, 1, 1),
+                ("data", "pipe", "seq", "model"))
+    dl = DeepSpeedDataLoader(ds, batch_size=8, mesh=mesh, num_workers=1,
+                             device_prefetch=True)
+    batch = next(iter(dl))
+    leaf = jax.tree_util.tree_leaves(batch)[0]
+    assert isinstance(leaf, jax.Array)
+    assert "data" in str(leaf.sharding.spec)
+
+
+def test_build_mlm_arrays_recipe_properties(tmp_path):
+    from deepspeed_tpu import tokenization as tok
+    text = ("the quick brown fox jumps over the lazy dog . " * 300)
+    words = sorted(set(text.split()))
+    vocab = tok.Vocab(list(tok.SPECIAL_TOKENS) + words)
+    tokenizer = tok.BertTokenizer(vocab)
+    fields = tok.build_mlm_arrays([text], tokenizer, seq_len=32,
+                                  max_predictions=5, seed=1, n_samples=8)
+    ids, mask = fields["input_ids"], fields["input_mask"]
+    pos, mids = fields["masked_positions"], fields["masked_ids"]
+    wts = fields["masked_weights"]
+    assert ids.shape == (8, 32) and pos.shape == (8, 5)
+    cls_id, sep_id = tokenizer.cls_id, tokenizer.sep_id
+    mask_id = vocab.id(tok.MASK_TOKEN)
+    for i in range(8):
+        L = int(mask[i].sum())
+        assert ids[i, 0] == cls_id and ids[i, L - 1] == sep_id
+        n_pred = int(wts[i].sum())
+        assert 1 <= n_pred <= 5
+        for j in range(n_pred):
+            p = pos[i, j]
+            assert 0 < p < L - 1                  # never CLS/SEP
+            assert mids[i, j] != 0                # original token recorded
+        # ~80% of masked positions actually carry [MASK]
+    masked_frac = float(np.mean(
+        (np.take_along_axis(ids, pos, axis=1) == mask_id)[wts > 0]))
+    assert 0.5 < masked_frac <= 1.0

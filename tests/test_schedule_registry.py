@@ -109,3 +109,31 @@ def test_onecycle_stair_count_cli_overrides():
     assert cfg["params"]["cycle_second_stair_count"] == 9
     # unset sentinel dropped
     assert "cycle_second_step_size" not in cfg["params"]
+
+
+def test_warmup_linear_decay_exp_recipe_schedule():
+    # the bing_bert 16K-batch recipe schedule (WALLCLOCK.md): linear
+    # warmup then decay_rate**(steps/decay_step)
+    from deepspeed_tpu.lr_schedules import SCHEDULES
+    opt = _Holder(0.0)
+    s = SCHEDULES["warmup_linear_decay_exp"](
+        opt, lr=4e-3, total_steps=1000, warmup_proportion=0.02,
+        decay_rate=0.9, decay_step=100)
+    lrs = []
+    for _ in range(240):
+        s.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    # warmup: 20 linear steps up to lr
+    assert abs(lrs[0] - 4e-3 / 20) < 1e-9
+    assert abs(lrs[19] - 4e-3) < 1e-9
+    # decay: one decay_step later lr has decayed by decay_rate
+    assert abs(lrs[120] - 4e-3 * 0.9) / 4e-3 < 1e-6
+    assert abs(lrs[220] - 4e-3 * 0.81) / 4e-3 < 1e-6
+    # round-trips through state_dict
+    s2 = SCHEDULES["warmup_linear_decay_exp"](
+        _Holder(0.0), lr=4e-3, total_steps=1000,
+        warmup_proportion=0.02, decay_rate=0.9, decay_step=100)
+    s2.load_state_dict(s.state_dict())
+    s2.step()
+    s.step()
+    assert s.get_last_lr() == s2.get_last_lr()
