@@ -585,7 +585,9 @@ def run_data_bench(steps=4, warmup=2):
         with open(path) as f:
             texts.append(f.read())
     corpus = "\n".join(texts)
-    words = sorted(set(w for w in corpus.split() if w))
+    # the docs mention the special tokens literally — dedup against them
+    words = sorted(set(w for w in corpus.split() if w)
+                   - set(tok.SPECIAL_TOKENS))
     vocab = tok.Vocab(list(tok.SPECIAL_TOKENS) + words)
     tokenizer = tok.BertTokenizer(vocab)
     B = mb * jax.device_count() * gas
