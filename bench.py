@@ -221,12 +221,18 @@ def _pp_analytic_row(pp, schedule, m, layers, hidden, seq, vocab):
 
     1F1B (_run_1f1b): m+2(pp-1) ticks, each = 1 body forward + a
     recompute-from-ring VJP (1 forward replay + 2 pull) = 4 body units,
-    PLUS a head forward + head VJP (3 head units) every tick.
-    Activation residency: the min(m, 2pp-1) input ring — the memory win
-    the schedule exists for.
+    PLUS the in-schedule head.  Since r5 the head is 1/pp-SHARDED over
+    the micro-batch (broadcast yb from the last stage, per-stage slice
+    VJP, psum-reassembled dy — mirroring pipe_sharded_loss), so it
+    costs 3/pp head units + 2 activation psums per tick instead of the
+    3 fully-replicated units the r4 sweep measured.  Activation
+    residency: the min(m, 2pp-1) input ring — the memory win the
+    schedule exists for.
     """
     body_tok = _pp_body_tok_flops(hidden, seq)
     head_tok = _pp_head_tok_flops(hidden, vocab)
+    psums = 0       # full-activation psums (gpipe's output collect is
+    # counted once; 1f1b's per-tick head broadcast/gather dominate)
     if pp == 1:
         ticks, body_units, head_units = m, 3.0 * m, 3.0 * m
         ppermutes, ring = 0, m
@@ -235,13 +241,15 @@ def _pp_analytic_row(pp, schedule, m, layers, hidden, seq, vocab):
         body_units = 3.0 * ticks            # 1 fwd + 2 bwd per tick
         head_units = 3.0 * m / pp           # sharded (pipe_sharded_loss)
         ppermutes = 2 * ticks
+        psums = 1                           # the [m, mb, ...] collect
         ring = ticks                        # scan residuals
     else:                                   # 1f1b
         ticks = m + 2 * (pp - 1)
         body_units = 4.0 * ticks            # fwd + recompute + 2 pull
-        head_units = 3.0 * ticks            # head vjp EVERY tick, masked
+        head_units = 3.0 * ticks / pp       # sharded in-schedule head
         ppermutes = 2 * ticks
-        ring = min(m, 2 * pp - 1)
+        psums = 2 * ticks                   # yb broadcast + dy gather,
+        ring = min(m, 2 * pp - 1)           # full-activation each
     # per-device fwd-FLOPs per step per (micro-batch token): bubbles and
     # masked head work included — this is what the device EXECUTES
     flops = (body_units * (layers / pp) * body_tok
@@ -249,6 +257,7 @@ def _pp_analytic_row(pp, schedule, m, layers, hidden, seq, vocab):
     return {"pp": pp, "schedule": schedule, "ticks": ticks,
             "body_units": body_units, "head_units": head_units,
             "ppermutes_per_step": ppermutes,
+            "activation_psums_per_step": psums,
             "activation_ring_slots": ring,
             "device_flops_per_micro_token": round(flops, 0),
             "theory_bubble_eff": round(m / (m + pp - 1), 3)}
@@ -375,20 +384,19 @@ def run_pipeline_sweep(steps=4, warmup=2):
            "rows": rows,
            "note": ("1F1B trades compute for memory BY DESIGN: 4 body "
                     "units/tick (activation recompute) over m+2(pp-1) "
-                    "ticks vs GPipe's 3 over m+pp-1, and its in-schedule "
-                    "head VJP runs REPLICATED on every stage every tick "
-                    "(SPMD; all but the last stage masked) while GPipe's "
-                    "off-schedule head is 1/pp-sharded.  At this sweep's "
-                    "toy shape the head is %.0fx the per-stage body at "
-                    "pp=%d, so the analytic gpipe/1f1b ratio there is "
-                    "~%.0fx — the r4 'pp=8 collapse' reproduced from "
-                    "first principles: structural head domination at a "
-                    "toy shape, not a scheduler bug (virtual-mesh timing "
-                    "noise added the rest).  1F1B's win is the "
-                    "min(m,2pp-1) activation ring vs GPipe's m+pp-1 scan "
-                    "residuals (activation_ring_slots); prefer it when "
-                    "activations, not FLOPs, bound the config."
-                    % (head_ratio, pp_max, ratio))}
+                    "ticks vs GPipe's 3 over m+pp-1.  Its in-schedule "
+                    "head VJP is 1/pp-SHARDED since r5 (broadcast yb, "
+                    "per-stage slice, psum dy) — before that it ran "
+                    "replicated on every stage every tick, which at this "
+                    "toy shape (head %.0fx the per-stage body at pp=%d) "
+                    "was the r4 'pp=8 collapse': structural head "
+                    "domination, not a scheduler bug.  Post-fix analytic "
+                    "gpipe/1f1b ratio at pp=%d: %.1fx (body recompute + "
+                    "extra ticks remain — the price of the min(m,2pp-1) "
+                    "activation ring vs GPipe's m+pp-1 scan residuals; "
+                    "prefer 1F1B when activations, not FLOPs, bound the "
+                    "config)."
+                    % (head_ratio, pp_max, pp_max, ratio))}
     _emit(out)
     return 0
 

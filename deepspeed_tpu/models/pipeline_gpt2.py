@@ -76,9 +76,10 @@ class GPT2Pipelined(GPT2):
         x_micro = x.reshape(m, B // m, T_len, x.shape[-1])
 
         if self.schedule == "1f1b":
-            # interleaved schedule: the per-micro head runs on the last
-            # stage inside the pipeline scan (standard 1F1B — the head is
-            # not stage-sharded on this path)
+            # interleaved schedule: the per-micro head runs inside the
+            # pipeline scan, 1/pp-sharded over the micro-batch when
+            # mb % pp == 0 (replicated fallback otherwise) — see
+            # parallel.pipeline._run_1f1b
             labels_micro = labels.reshape(m, B // m, T_len)
             count = jnp.sum((labels >= 0).astype(jnp.float32))
             head_params = {"lnf_s": params["lnf_s"],

@@ -243,13 +243,57 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
         xb = jax.lax.dynamic_index_in_dim(
             ring, jnp.mod(b, R), axis=0, keepdims=False)
         (yb, aux_b), pull = jax.vjp(stage_fn, blocks, xb)
-        lab = jax.lax.dynamic_index_in_dim(
-            labf, jnp.clip(b, 0, m - 1), axis=0, keepdims=False)
-        lsum, hpull = jax.vjp(
-            lambda hp, yy: jnp.asarray(head_fn(hp, yy, lab), jnp.float32),
-            head_params, yb)
-        dhead_b, dy_head = hpull(jnp.asarray(seed, jnp.float32))
-        dy = jnp.where(is_last, dy_head.astype(yb.dtype), bwd_buf)
+
+        mb = x_micro.shape[1]
+        if mb % pp == 0 and pp > 1:
+            # SHARDED in-schedule head (r5, the cost model's biggest
+            # finding): under SPMD the head VJP used to run on EVERY
+            # stage every tick with all but the last stage's masked —
+            # 3 head units/tick of pure waste.  Instead: broadcast the
+            # LAST stage's recompute output, each stage computes the head
+            # fwd+VJP on ITS 1/pp batch slice (micro b_last = t-(pp-1),
+            # the micro the last stage is backwarding), and the dy slices
+            # psum-reassemble.  Head cost per tick drops to 3/pp units
+            # + two [mb,...] collectives; head grads become per-stage
+            # partials the engine's pipe-psum already sums.
+            sl = mb // pp
+            b_last = t - (pp - 1)
+            active_h = (b_last >= 0) & (b_last < m)
+            yb_last = jax.lax.psum(
+                jnp.where(is_last, yb, jnp.zeros_like(yb)), axis)
+            ys = jax.lax.dynamic_slice_in_dim(yb_last, stage * sl, sl,
+                                              axis=0)
+            lab_h = jax.lax.dynamic_index_in_dim(
+                labf, jnp.clip(b_last, 0, m - 1), axis=0, keepdims=False)
+            lab_s = jax.lax.dynamic_slice_in_dim(lab_h, stage * sl, sl,
+                                                 axis=0)
+            lsum_s, hpull = jax.vjp(
+                lambda hp, yy: jnp.asarray(head_fn(hp, yy, lab_s),
+                                           jnp.float32),
+                head_params, ys)
+            dhead_b, dy_s = hpull(jnp.asarray(seed, jnp.float32))
+            dy_full = jax.lax.psum(
+                jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros_like(yb_last), dy_s.astype(yb_last.dtype),
+                    stage * sl, axis=0), axis)
+            dy = jnp.where(is_last, dy_full.astype(yb.dtype), bwd_buf)
+            lsum = jax.lax.psum(lsum_s, axis)
+            acc_h = jnp.where(active_h, 1.0, 0.0)   # partials, ALL stages
+            loss_active = active_h & is_last
+        else:
+            # replicated fallback (mb not divisible by pp): every stage
+            # runs the full head on its own yb; only the last stage's is
+            # real
+            lab = jax.lax.dynamic_index_in_dim(
+                labf, jnp.clip(b, 0, m - 1), axis=0, keepdims=False)
+            lsum, hpull = jax.vjp(
+                lambda hp, yy: jnp.asarray(head_fn(hp, yy, lab),
+                                           jnp.float32),
+                head_params, yb)
+            dhead_b, dy_head = hpull(jnp.asarray(seed, jnp.float32))
+            dy = jnp.where(is_last, dy_head.astype(yb.dtype), bwd_buf)
+            acc_h = jnp.where(active_b & is_last, 1.0, 0.0)
+            loss_active = active_b & is_last
         # aux averages over micros: d(loss)/d(aux_b) = 1/m (bubble ticks
         # are zeroed by the acc_b accumulation mask below)
         daux = jnp.asarray(1.0 / m, jnp.result_type(aux_b))
@@ -258,7 +302,6 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
         acc_b = jnp.where(active_b, 1.0, 0.0)
         gblocks = jax.tree_util.tree_map(
             lambda a, g: a + acc_b * g, gblocks, dblocks_b)
-        acc_h = jnp.where(active_b & is_last, 1.0, 0.0)
         ghead = jax.tree_util.tree_map(
             lambda a, g: a + acc_h * g, ghead, dhead_b)
         dx_out = jnp.where(
@@ -266,7 +309,7 @@ def _run_1f1b(stage_fn, head_fn, axis, blocks, head_params, x_micro,
             jax.lax.dynamic_update_index_in_dim(
                 dx_out, dxin, jnp.clip(b, 0, m - 1), axis=0),
             dx_out)
-        loss_sum = loss_sum + jnp.where(active_b & is_last,
+        loss_sum = loss_sum + jnp.where(loss_active,
                                         lsum.astype(jnp.float32), 0.0)
         aux_sum = aux_sum + jnp.where(
             active_b, jnp.asarray(aux_b, jnp.float32), 0.0)

@@ -277,3 +277,24 @@ def test_zero_and_checkpoint_compose_with_pipeline(tmpdir):
     import os
     files = os.listdir(os.path.join(str(tmpdir), "t"))
     assert any("pp_stage_01" in f for f in files), files
+
+
+def test_1f1b_sharded_head_matches_plain():
+    """The SHARDED in-schedule head branch (r5: mb % pp == 0 broadcasts
+    the last stage's output and splits the head VJP 1/pp per stage) must
+    be trajectory-identical to the plain model.  Every other 1F1B test
+    here runs mb=1 and exercises only the replicated fallback — this
+    config (pp=4, m=4, per-shard batch 16 -> mb=4) pins the sharded
+    gradient path numerically: a wrong slice offset or psum-reassembly
+    would shift every loss."""
+    kw = dict(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=4,
+              hidden_size=32, num_heads=4)
+    plain = GPT2.from_size("tiny", **kw)
+    pipelined = GPT2Pipelined.from_size("tiny", num_micro_batches=4, **kw)
+    ref, _ = run_engine(plain, make_mesh(), batch=32)
+    got, engine = run_engine(
+        pipelined, make_mesh(pipeline_parallel_size=4), batch=32,
+        pipeline_schedule="1f1b")
+    # per-shard micro-batch = 32*4/8/4 = 4, divisible by pp=4 -> sharded
+    assert engine.module.schedule == "1f1b"
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
