@@ -209,8 +209,7 @@ def _pp_analytic_row(pp, schedule, m, layers, hidden, seq, vocab):
     on one micro-batch; one "head unit" = one head forward on one
     micro-batch (LN -> vocab logits -> CE sum; its VJP pull costs ~2
     more).  SPMD means EVERY stage executes every tick's full program —
-    bubble ticks burn the same FLOPs as live ones, and the 1F1B head
-    VJP runs on all stages every tick with all but the last stage masked.
+    bubble ticks burn the same FLOPs as live ones.
 
     GPipe (pipeline_apply + scan autodiff): m+pp-1 forward ticks (1 body)
     + m+pp-1 backward ticks (2 body; residuals saved, no recompute); the

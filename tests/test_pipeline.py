@@ -298,3 +298,19 @@ def test_1f1b_sharded_head_matches_plain():
     # per-shard micro-batch = 32*4/8/4 = 4, divisible by pp=4 -> sharded
     assert engine.module.schedule == "1f1b"
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_gpipe_scatter_collect_matches_plain():
+    """The scatter-collect boundary (r5: psum_scatter delivers each stage
+    its 1/pp output slice instead of psum-replicating the full volume)
+    must be trajectory-identical to the plain model.  Most pipeline tests
+    run mb < pp and take the full-collect fallback; this config (pp=2,
+    per-shard batch 4, m=2 -> mb=2) exercises the scattered path."""
+    kw = dict(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=4,
+              hidden_size=32, num_heads=4)
+    plain = GPT2.from_size("tiny", **kw)
+    pipelined = GPT2Pipelined.from_size("tiny", num_micro_batches=2, **kw)
+    ref, _ = run_engine(plain, make_mesh(), batch=16)
+    got, _ = run_engine(pipelined, make_mesh(pipeline_parallel_size=2),
+                        batch=16)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
