@@ -38,14 +38,14 @@ echo "== real-data input path vs synthetic =="
 BENCH_DATA=1 BENCH_OUT=bench_data.json python bench.py
 
 echo "== attention kernel sweep =="
-for SEQ in 128 512 1024 2048; do
+for SEQ in 128 512 1024 2048 4096; do
     BENCH_ATTN_SWEEP=1 BENCH_SEQ=$SEQ BENCH_OUT=bench_attn_seq${SEQ}.json \
         python bench.py
 done
 python - <<'EOF'
 import json, os
 rows = []
-for seq in (128, 512, 1024, 2048):
+for seq in (128, 512, 1024, 2048, 4096):
     with open(f"bench_attn_seq{seq}.json") as f:
         rows.append(json.load(f))
     os.remove(f"bench_attn_seq{seq}.json")
