@@ -22,6 +22,7 @@ batch x remat sweep (rows on stderr, best on stdout), BENCH_OUT=<path> to
 also write the JSON line to a file (committed sweep artifacts),
 BENCH_PP_SWEEP=1 with BENCH_PP_SCHEDULES=gpipe,1f1b for the pipeline
 schedule sweep, BENCH_ATTN_SWEEP=1 for the attention-kernel sweep,
+BENCH_HEAD=1 for the MLM-head sparse-vs-dense microbench (CPU-safe),
 BENCH_DEVICE_TIMEOUT (default 600 s; <= 0 disables) to fail crisply
 instead of hanging when the device tunnel is wedged.
 
@@ -746,6 +747,133 @@ def zero_flat_like(params):
     return jnp.zeros((padded,), jnp.float32) + 1e-2
 
 
+def run_head_bench(repeats=None):
+    """MLM-head microbench (the phase-2 seq-512 maxpred-80 suspect,
+    bench_mfu_breakdown.json): dense [B,T,H]→vocab head vs the sparse
+    masked-position paths, fwd+grad, jitted, chained-execution timing.
+
+    Legs: ``dense`` (full [B, T, vocab] logits + masked CE), ``sparse``
+    (dense-labels format with mlm_gather_budget — top_k select + gather),
+    ``maskedpos_take`` / ``maskedpos_onehot`` (the standard BingBert
+    positions/ids/weights format with the two gather impls —
+    DSTPU_MLM_GATHER).  CPU-safe (shapes shrink off-TPU); the committed
+    artifact records the platform, so CPU rows are never mistaken for
+    chip numbers.  One JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.models import BertForPreTraining
+    from deepspeed_tpu.models import layers as L_mod
+    from deepspeed_tpu.parallel.topology import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    T = int(os.environ.get("BENCH_SEQ", "512"))
+    n_pred = int(os.environ.get("BENCH_MAXPRED", "80"))
+    B = int(os.environ.get("BENCH_BATCH", "24" if on_tpu else "4"))
+    H = 1024 if on_tpu else 128
+    V = 30528 if on_tpu else 4096
+    reps = repeats or int(os.environ.get("BENCH_STEPS",
+                                         "20" if on_tpu else "3"))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32),
+                    jnp.bfloat16 if on_tpu else jnp.float32)
+    dense_labels = np.full((B, T), -1, np.int32)
+    positions = np.stack([np.sort(rng.choice(T, size=n_pred, replace=False))
+                          for _ in range(B)]).astype(np.int32)
+    mlm_ids = rng.integers(0, V, size=(B, n_pred)).astype(np.int32)
+    np.put_along_axis(dense_labels, positions, mlm_ids, axis=1)
+    weights = np.ones((B, n_pred), np.float32)
+
+    mesh = make_mesh(model_parallel_size=1)
+    model = BertForPreTraining.from_size(
+        "tiny", vocab_size=V, max_seq_len=T, hidden_size=H,
+        num_heads=max(4, H // 64), num_layers=1)
+    params = model.init_params(jax.random.PRNGKey(0))
+    head_keys = ("mlm_dense_w", "mlm_dense_b", "mlm_ln_s", "mlm_ln_b",
+                 "mlm_bias", "wte")
+    head_params = {k: params[k] for k in head_keys}
+
+    def head_loss(kind):
+        def dense(hp, h):
+            logits = model._mlm_head(hp, h)
+            tok = L_mod.vocab_parallel_cross_entropy(
+                logits, jnp.asarray(dense_labels))
+            return L_mod.masked_mean_loss(tok, jnp.asarray(dense_labels) >= 0)
+
+        def sparse(hp, h):
+            maskf = (jnp.asarray(dense_labels) >= 0).astype(jnp.float32)
+            w, pos = jax.lax.top_k(maskf, n_pred)
+            ids = jnp.clip(jnp.take_along_axis(
+                jnp.asarray(dense_labels), pos, axis=1), 0, None)
+            h_m = L_mod.gather_positions(h, pos)
+            tok = L_mod.vocab_parallel_cross_entropy(
+                model._mlm_head(hp, h_m), ids)
+            return jnp.sum(tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+        def maskedpos(hp, h):
+            h_m = L_mod.gather_positions(h, jnp.asarray(positions))
+            tok = L_mod.vocab_parallel_cross_entropy(
+                model._mlm_head(hp, h_m), jnp.asarray(mlm_ids))
+            w = jnp.asarray(weights)
+            return jnp.sum(tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+        body = {"dense": dense, "sparse": sparse,
+                "maskedpos": maskedpos}[kind]
+
+        def local(hp, h):
+            # grads wrt head params AND the backbone activation (the real
+            # training pullback — the scatter-vs-matmul VJP is the point)
+            return jax.value_and_grad(
+                lambda hp_, h_: jnp.asarray(body(hp_, h_), jnp.float32),
+                argnums=(0, 1))(hp, h)
+
+        specs = jax.tree_util.tree_map(lambda _: P(), head_params)
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(specs, P()),
+            out_specs=(P(), (specs, P())), check_vma=False))
+
+    def timed(fn):
+        out = fn(head_params, x)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        acc = jnp.zeros((), jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            loss, _ = fn(head_params, x)
+            acc = acc + loss
+        float(acc)
+        return (time.perf_counter() - t0) / reps
+
+    rows = []
+    for leg, gather in (("dense", None), ("sparse", "auto"),
+                        ("maskedpos", "take"), ("maskedpos", "onehot")):
+        if gather:
+            os.environ["DSTPU_MLM_GATHER"] = gather
+        try:
+            dt = timed(head_loss(leg.split("_")[0]))
+        finally:
+            os.environ.pop("DSTPU_MLM_GATHER", None)
+        name = leg if gather in (None, "auto") else f"{leg}_{gather}"
+        rows.append({"leg": name, "ms_per_step": round(dt * 1000, 2)})
+        print(f"head {name}: {dt * 1e3:.2f} ms", file=sys.stderr)
+
+    dense_ms = rows[0]["ms_per_step"]
+    sparse_ms = rows[1]["ms_per_step"]
+    _emit({"metric": "bert_mlm_head_sparse_vs_dense",
+           "value": round(dense_ms / max(sparse_ms, 1e-6), 3),
+           "unit": "x dense-head cost vs sparse masked-position gather "
+                   "(fwd+grad)",
+           "platform": jax.default_backend(),
+           "seq": T, "n_pred": n_pred, "batch": B, "hidden": H, "vocab": V,
+           "rows": rows,
+           "note": ("CPU rows establish the algorithmic ratio only; "
+                    "re-measure on chip with BENCH_HEAD=1 python bench.py "
+                    "(the gather-VJP scatter the onehot path removes is "
+                    "TPU-specific, so the chip ratio is LARGER)")})
+    return 0
+
+
 def run_ckpt_bench(tmpdir=None):
     """Checkpoint save-stall measurement (VERDICT r4 weak #3): BERT-large
     (the headline model) through engine.save_checkpoint in sync and async
@@ -889,6 +1017,8 @@ def main():
         return run_mfu_breakdown()
     if os.environ.get("BENCH_OPT", "0") == "1":
         return run_opt_bench()
+    if os.environ.get("BENCH_HEAD", "0") == "1":
+        return run_head_bench()
     if os.environ.get("BENCH_DATA", "0") == "1":
         return run_data_bench()
     if os.environ.get("BENCH_ATTN_SWEEP", "0") == "1":

@@ -15,6 +15,8 @@ Pallas kernels), ``parallel`` (mesh/collectives/pipeline), ``zero3``
 (parameter-partitioning helpers).
 """
 
+from deepspeed_tpu import compat as _compat  # noqa: F401  (installs jax shims)
+
 __version__ = "0.1.0"
 __version_major__, __version_minor__, __version_patch__ = (
     int(x) for x in __version__.split("."))

@@ -449,10 +449,18 @@ def _shard_np(x):
 
 
 def _snapshot_put(x):
-    """Async-save leaf transform: host np copy now, chunk-write later."""
+    """Async-save leaf transform: host np copy now, chunk-write later.
+
+    The copy must be EXPLICIT (``np.array(..., copy=True)``):
+    ``np.asarray`` on a jax array may return a zero-copy view of the
+    device/host buffer on backends that allow it (CPU, and donated-buffer
+    aliasing), and the async writer's "copy before donate" contract says
+    the snapshot must survive the next train step overwriting that buffer
+    — relying on backend-specific copy behavior is a silent-corruption
+    bug waiting for a backend change (ADVICE round 5)."""
     if _z3_marker(x) or x is None:
         return x
-    return np.asarray(_shard_np(x))
+    return np.array(_shard_np(x), copy=True)
 
 
 def _stream_put(writer):

@@ -1,0 +1,68 @@
+"""JAX version compatibility shims.
+
+The engine targets the current ``jax.shard_map`` API (top-level export,
+``check_vma=`` keyword).  Older jax releases (< 0.5) ship the same
+functionality as ``jax.experimental.shard_map.shard_map`` with the keyword
+spelled ``check_rep``, and lack ``jax.distributed.is_initialized``.  Rather
+than scatter try/excepts through every call site (engine, metrics, tests,
+benches all build shard_maps), this module installs the modern names onto
+the ``jax`` module once, at package import.  On a current jax it is a no-op.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None:
+            # old spelling of the same knob (replicated-output checking)
+            kwargs.setdefault("check_rep", bool(check_vma))
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    shard_map.__doc__ = _shard_map.__doc__
+    jax.shard_map = shard_map
+
+
+def _install_distributed_is_initialized() -> None:
+    if hasattr(jax.distributed, "is_initialized"):
+        return
+
+    def is_initialized() -> bool:
+        try:
+            from jax._src.distributed import global_state
+        except ImportError:  # pragma: no cover - very old jax
+            return False
+        return getattr(global_state, "client", None) is not None
+
+    jax.distributed.is_initialized = is_initialized
+
+
+def _install_axis_size() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # psum of a concrete 1 constant-folds to the static axis size (a
+        # python int) inside shard_map/pmap traces, and raises the same
+        # NameError as the modern API on an unbound axis
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+def install() -> None:
+    """Idempotent; called from ``deepspeed_tpu/__init__``."""
+    _install_shard_map()
+    _install_distributed_is_initialized()
+    _install_axis_size()
+
+
+install()

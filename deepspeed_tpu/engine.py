@@ -87,6 +87,10 @@ class _PendingStep:
     def __init__(self, engine, batch):
         self.engine = engine
         self.batch = batch
+        # bind the program at CREATION: a later forward with a different
+        # batch format swaps engine._fwdbwd_fn, and forcing this pending
+        # must run the program its own batch was traced for
+        self.fn = engine._fwdbwd_fn
         self.loss = None  # filled by force()
 
     @property
@@ -96,7 +100,7 @@ class _PendingStep:
     def force(self):
         if self.loss is None:
             e = self.engine
-            loss, grads = e._fwdbwd_fn(
+            loss, grads = self.fn(
                 e.params, e.loss_scale_state.cur_scale, self.batch)
             # only the engine's CURRENT pending may feed a later backward();
             # a superseded one must not poison the cached grads / last loss
@@ -105,9 +109,11 @@ class _PendingStep:
                 e._last_loss = loss
             self.loss = loss
             # the loss values are all a _DeferredLoss can still need; don't
-            # pin the micro-batch (or the engine) for its lifetime
+            # pin the micro-batch, the engine, or the compiled executable
+            # (format-cache eviction must be able to free it)
             self.batch = None
             self.engine = None
+            self.fn = None
         return self.loss
 
 
@@ -688,11 +694,28 @@ class DeepSpeedTpuEngine:
                                if self.tensorboard_enabled()
                                and jax.process_index() == 0 else None)
 
-        # -- compiled-function caches
+        # -- compiled-function caches.  The batch-consuming programs are
+        #    keyed on the batch FORMAT (pytree structure + leaf
+        #    shapes/dtypes): the shard_map in_specs are baked per format
+        #    (engine._batch_specs picks P(data) vs P() by leaf rank; BERT
+        #    accepts dense-labels AND masked-positions batches), so a
+        #    format switch must select another executable — never fail on
+        #    a spec mismatch, never recompile a format already built.
+        #    `_fwdbwd_fn`/`_eval_fn`/`_train_batch_fn` hold the CURRENT
+        #    key's entry (only swapped on a key change, so tests may wrap
+        #    them); the dicts keep the rest, evicting oldest past
+        #    _BATCH_FN_CACHE_SIZE.
         self._fwdbwd_fn = None
+        self._fwdbwd_key = None
+        self._fwdbwd_fns = {}
         self._eval_fn = None
+        self._eval_key = None
+        self._eval_fns = {}
         self._step_fn = None
         self._train_batch_fn = None
+        self._train_batch_key = None
+        self._train_batch_fns = {}
+        self._loss_treedefs = {}    # loss pytree structure per batch key
         self._acc = None            # accumulated local grads ([dp, ...] tree)
         self._cached_grads = None   # grads from the last forward
         self._pending = None        # latest train-mode forward not yet run
@@ -700,6 +723,8 @@ class DeepSpeedTpuEngine:
         self._loss_treedef = None   # model loss pytree structure (cached)
         self._last_loss = None
         self._profiling = False
+        self._hyper_key = None      # host values behind the staged hypers
+        self._hyper_dev = None      # cached [4, G] device array
 
         if self.config.dump_state:
             self.dump_state()
@@ -1313,6 +1338,32 @@ class DeepSpeedTpuEngine:
             rows = bool(self._zero_state_axes)
         return gpart[None] if rows else gpart
 
+    #: built batch-format executables kept per engine (a training run
+    #: alternating two MLM formats needs exactly two)
+    _BATCH_FN_CACHE_SIZE = 8
+
+    @staticmethod
+    def _batch_cache_key(batch):
+        """Cache key of a batch's FORMAT: pytree structure + per-leaf
+        shape/dtype.  Shapes are included because the shard_map in_specs
+        depend on leaf rank (``_batch_specs``: P(data) for arrays, P() for
+        scalars) and a model's ``batch_specs`` hook may inspect shapes —
+        structure alone would silently reuse wrong specs."""
+        flat, treedef = jax.tree_util.tree_flatten(batch)
+        return (treedef,
+                tuple((tuple(getattr(leaf, "shape", ())),
+                       str(getattr(leaf, "dtype", type(leaf).__name__)))
+                      for leaf in flat))
+
+    def _cached_batch_fn(self, cache, key, build):
+        fn = cache.get(key)
+        if fn is None:
+            if len(cache) >= self._BATCH_FN_CACHE_SIZE:
+                cache.pop(next(iter(cache)))    # FIFO evict the oldest
+            fn = build()
+            cache[key] = fn
+        return fn
+
     def _build_fwdbwd(self, batch):
         loss_and_grads = self._make_loss_and_grads()
         stage2 = self.zero_stage == 2
@@ -1380,13 +1431,19 @@ class DeepSpeedTpuEngine:
             # the next param mutation (an eval-mode forward leaves the live
             # train pending in place — backward() may still consume it)
             self._pending = None
-            if self._fwdbwd_fn is None:
-                self._fwdbwd_fn = self._build_fwdbwd(batch)
+            key = self._batch_cache_key(batch)
+            if self._fwdbwd_fn is None or self._fwdbwd_key != key:
+                self._fwdbwd_fn = self._cached_batch_fn(
+                    self._fwdbwd_fns, key,
+                    lambda: self._build_fwdbwd(batch))
+                self._fwdbwd_key = key
+                self._loss_treedef = self._loss_treedefs.get(key)
             if self._loss_treedef is None:
                 loss_shape, _ = jax.eval_shape(
                     self._fwdbwd_fn, self.params,
                     self.loss_scale_state.cur_scale, batch)
                 self._loss_treedef = jax.tree_util.tree_structure(loss_shape)
+                self._loss_treedefs[key] = self._loss_treedef
             self._pending = _PendingStep(self, batch)
             self._pending_refs = [r for r in self._pending_refs
                                   if r() is not None]
@@ -1401,8 +1458,14 @@ class DeepSpeedTpuEngine:
                 # breakdown")
                 self.timers(FORWARD_TIMER).stop()
         else:
-            if self._eval_fn is None:
-                self._eval_fn = self._build_eval(batch)
+            # eval time must not be billed to the next training-throughput
+            # report window (timer.py window accounting)
+            self.tput_timer.discard_window()
+            key = self._batch_cache_key(batch)
+            if self._eval_fn is None or self._eval_key != key:
+                self._eval_fn = self._cached_batch_fn(
+                    self._eval_fns, key, lambda: self._build_eval(batch))
+                self._eval_key = key
             loss = self._eval_fn(self.params, batch)
             self._last_loss = loss
             if wcb:
@@ -1504,11 +1567,15 @@ class DeepSpeedTpuEngine:
         group_ids = self._group_ids
         multi_group = len(self._group_defs) > 1
 
-        def step_local(master, opt_state, grads, ls_state, lr, b1, b2, wd,
+        def step_local(master, opt_state, grads, ls_state, hypers,
                        normw, gids):
-            # hypers arrive as [G] vectors (one per param group); expand to
+            # hypers arrive as ONE stacked [4, G] array (lr/b1/b2/wd rows,
+            # one column per param group) — a single host→device staging
+            # per boundary instead of four (and zero when the scheduler
+            # didn't move, engine._current_hypers caches); expand to
             # per-leaf trees when groups exist (per-ELEMENT vectors over
             # the flat partition under ZeRO), else the plain scalars
+            lr, b1, b2, wd = hypers[0], hypers[1], hypers[2], hypers[3]
             if not multi_group:
                 lr, b1, b2, wd = lr[0], b1[0], b2[0], wd[0]
             elif zero:
@@ -1749,7 +1816,7 @@ class DeepSpeedTpuEngine:
         stage2 = self.zero_stage == 2
         zero3 = self.zero3
 
-        def local(master, opt_state, acc, ls_state, lr, b1, b2, wd, normw,
+        def local(master, opt_state, acc, ls_state, hypers, normw,
                   gids):
             if stage2:
                 # acc IS the accumulated flat partition (ZeRO-2)
@@ -1761,8 +1828,8 @@ class DeepSpeedTpuEngine:
             else:
                 # acc leaves arrive as [1, ...] local slices
                 grads = jax.tree_util.tree_map(lambda g: g[0], acc)
-            return step_local(master, opt_state, grads, ls_state, lr, b1, b2,
-                              wd, normw, gids)
+            return step_local(master, opt_state, grads, ls_state, hypers,
+                              normw, gids)
 
         master_spec, opt_spec, ls_spec = self._step_specs()
         fn = jax.shard_map(
@@ -1771,7 +1838,7 @@ class DeepSpeedTpuEngine:
                       self._zero_flat_spec() if stage2
                       else self._z3_grad_specs() if zero3
                       else self._grad_stack_specs(),
-                      ls_spec, P(), P(), P(), P(), P(DATA_AXIS),
+                      ls_spec, P(), P(DATA_AXIS),
                       P(DATA_AXIS)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P()),
@@ -1912,20 +1979,34 @@ class DeepSpeedTpuEngine:
                 getattr(self, "sample_count", self.global_steps))
 
     def _current_hypers(self):
-        """Live hyperparameters from the facade groups, each a [G] vector
-        (one entry per param group): LR schedules may have written different
+        """Live hyperparameters from the facade groups as ONE stacked
+        [4, G] fp32 device array (rows lr/beta1/beta2/weight_decay, one
+        column per param group): LR schedules may have written different
         LRs into each group, OneCycle cycles per-group betas
         (lr_schedules.py), and decay-excluded groups carry weight_decay=0
         (the published BERT recipe, reference
-        docs/_tutorials/bert-pretraining.md:289-305)."""
+        docs/_tutorials/bert-pretraining.md:289-305).
+
+        Staging is CACHED on the host values: the four per-step
+        ``jnp.asarray`` transfers the old tuple form paid at EVERY
+        boundary (part of the fixed per-step dispatch cost gas=8 cannot
+        amortize, bench_mfu_breakdown.json
+        ``per_step_fixed_lamb_dispatch``) collapse to one transfer when a
+        scheduler moved a value and ZERO when none did (constant-LR runs,
+        and every run's beta/wd rows)."""
         base = self.base_optimizer
         groups = self.optimizer.param_groups
         betas = [g.get("betas", (base.beta1, base.beta2)) for g in groups]
-        return (jnp.asarray([g["lr"] for g in groups], jnp.float32),
-                jnp.asarray([b[0] for b in betas], jnp.float32),
-                jnp.asarray([b[1] for b in betas], jnp.float32),
-                jnp.asarray([g.get("weight_decay", base.weight_decay)
-                             for g in groups], jnp.float32))
+        key = tuple((float(g["lr"]), float(b[0]), float(b[1]),
+                     float(g.get("weight_decay", base.weight_decay)))
+                    for g, b in zip(groups, betas))
+        if key != self._hyper_key:
+            rows = np.asarray(
+                [[k[0] for k in key], [k[1] for k in key],
+                 [k[2] for k in key], [k[3] for k in key]], np.float32)
+            self._hyper_dev = jnp.asarray(rows)
+            self._hyper_key = key
+        return self._hyper_dev
 
     def step(self):
         """Optimizer boundary step (reference deepspeed_light.py:709-807)."""
@@ -1940,11 +2021,11 @@ class DeepSpeedTpuEngine:
             if self._step_fn is None:
                 self._step_fn = self._build_step()
             master = self.master_flat if self.zero_flat else self.master
-            lr, b1, b2, wd = self._current_hypers()
             (self.params, new_master, self.opt_state, self.loss_scale_state,
              overflow, self._last_grad_norm) = self._step_fn(
                 master, self.opt_state, self._acc, self.loss_scale_state,
-                lr, b1, b2, wd, self._zero_norm_w, self._zero_gid_flat)
+                self._current_hypers(), self._zero_norm_w,
+                self._zero_gid_flat)
             if self.zero_flat:
                 self.master_flat = new_master
             else:
@@ -1988,7 +2069,7 @@ class DeepSpeedTpuEngine:
         # shard shapes — partitioned leaves are already scattered by the
         # gather transpose — and step_local consumes them in place)
 
-        def local(params, master, opt_state, ls_state, lr, b1, b2, wd,
+        def local(params, master, opt_state, ls_state, hypers,
                   normw, gids, batch_args):
             if gas == 1:
                 # no accumulator buffer, no scan machinery
@@ -2029,7 +2110,7 @@ class DeepSpeedTpuEngine:
                 last_loss = jax.tree_util.tree_map(lambda l: l[-1], losses)
             (params_new, master_new, opt_new, ls_new, overflow,
              total_norm) = step_local(master, opt_state, acc, ls_state,
-                                      lr, b1, b2, wd, normw, gids)
+                                      hypers, normw, gids)
             return (params_new, master_new, opt_new, ls_new, overflow,
                     total_norm, last_loss)
 
@@ -2037,7 +2118,7 @@ class DeepSpeedTpuEngine:
         fn = jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
-                      P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                      P(), P(DATA_AXIS), P(DATA_AXIS),
                       self._batch_specs(batch)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P(), P()),
@@ -2075,15 +2156,18 @@ class DeepSpeedTpuEngine:
             raise ValueError(
                 f"train_batch: leading batch dim {lead} is not divisible by "
                 f"gradient_accumulation_steps={gas}")
-        if self._train_batch_fn is None:
-            self._train_batch_fn = self._build_train_batch(batch)
+        key = self._batch_cache_key(batch)
+        if self._train_batch_fn is None or self._train_batch_key != key:
+            self._train_batch_fn = self._cached_batch_fn(
+                self._train_batch_fns, key,
+                lambda: self._build_train_batch(batch))
+            self._train_batch_key = key
         master = self.master_flat if self.zero_flat else self.master
-        lr, b1, b2, wd = self._current_hypers()
         (self.params, new_master, self.opt_state, self.loss_scale_state,
          overflow, self._last_grad_norm, loss) = self._train_batch_fn(
             self.params, master, self.opt_state, self.loss_scale_state,
-            lr, b1, b2, wd, self._zero_norm_w, self._zero_gid_flat,
-            batch)
+            self._current_hypers(), self._zero_norm_w,
+            self._zero_gid_flat, batch)
         if self.zero_flat:
             self.master_flat = new_master
         else:
@@ -2115,6 +2199,9 @@ class DeepSpeedTpuEngine:
         device→host snapshot; the file writes happen on a background
         thread — call :meth:`checkpoint_wait` to block until durable."""
         from deepspeed_tpu import checkpoint as ckpt_mod
+        # the save stall is not training throughput: keep it out of the
+        # next report window (timer.py window accounting)
+        self.tput_timer.discard_window()
         return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
                                         client_state=client_state,
                                         async_save=async_save)

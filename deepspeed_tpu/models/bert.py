@@ -89,16 +89,29 @@ class BertForPreTraining:
     """
     config: T.TransformerConfig
     use_nsp: bool = False
+    #: dense-labels MLM only: when set, gather up to this many masked
+    #: positions per sequence BEFORE the vocab projection (the sparse head
+    #: the masked-positions format gets for free), instead of the
+    #: [B, T, vocab] dense logits.  EXACTNESS CONTRACT: per-sequence masked
+    #: counts must not exceed the budget — overflow positions are silently
+    #: dropped from the loss (standard BERT data caps masking at
+    #: max_predictions_per_seq, so the pipeline's cap is the right value).
+    #: Clamped to the sequence length (budget >= T is always exact).  The
+    #: dense path remains the fallback: budget None, or sequence
+    #: parallelism > 1 (the gather indexes global positions).
+    mlm_gather_budget: object = None
     #: ZeRO-3 partition dims (set by the engine at stage 3; zero3.py)
     zero3_dims: object = None
 
     @classmethod
-    def from_size(cls, size: str, use_nsp: bool = False, **overrides):
+    def from_size(cls, size: str, use_nsp: bool = False,
+                  mlm_gather_budget=None, **overrides):
         kw = dict(BERT_SIZES[size])
         kw.update(overrides)
         kw.setdefault("pre_ln", False)   # BERT is post-LN
         kw.setdefault("causal", False)
-        return cls(T.TransformerConfig(**kw), use_nsp=use_nsp)
+        return cls(T.TransformerConfig(**kw), use_nsp=use_nsp,
+                   mlm_gather_budget=mlm_gather_budget)
 
     def validate(self, mp_size: int = 1):
         """Engine hook: shape checks against the actual mp degree."""
@@ -209,12 +222,31 @@ class BertForPreTraining:
                     z3_block_dims=z3_deferred.get("blocks"))
 
         if mlm_positions is None:
-            logits = self._mlm_head(params, x)
-            tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_labels)
-            loss = L.masked_mean_loss(tok_loss, mlm_labels >= 0)
+            budget = self.mlm_gather_budget
+            if budget and L.axis_size_or_1(SEQ_AXIS) == 1:
+                # sparse head for the dense-labels format: select <= budget
+                # masked positions per sequence (top_k of the 0/1 mask is
+                # stable, so masked positions come first, in order), gather
+                # them, and run the vocab projection on [B, P, H] instead
+                # of [B, T, H].  Matches the dense loss exactly while every
+                # sequence's masked count fits the budget (see the field
+                # docstring for the overflow contract).
+                P_ = min(int(budget), mlm_labels.shape[1])
+                maskf = (mlm_labels >= 0).astype(jnp.float32)
+                w, pos = jax.lax.top_k(maskf, P_)           # [B, P] each
+                ids = jnp.clip(jnp.take_along_axis(mlm_labels, pos, axis=1),
+                               0, None)                     # w=0 rows: any id
+                h_m = L.gather_positions(x, pos)
+                logits = self._mlm_head(params, h_m)        # [B, P, vocab/mp]
+                tok_loss = L.vocab_parallel_cross_entropy(logits, ids)
+                loss = (jnp.sum(tok_loss * w)
+                        / jnp.maximum(jnp.sum(w), 1.0))
+            else:
+                logits = self._mlm_head(params, x)
+                tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_labels)
+                loss = L.masked_mean_loss(tok_loss, mlm_labels >= 0)
         else:
-            h_m = jnp.take_along_axis(
-                x, mlm_positions[..., None].astype(jnp.int32), axis=1)
+            h_m = L.gather_positions(x, mlm_positions)
             logits = self._mlm_head(params, h_m)          # [B, P, vocab/mp]
             tok_loss = L.vocab_parallel_cross_entropy(logits, mlm_ids)
             w = mlm_weights.astype(jnp.float32)

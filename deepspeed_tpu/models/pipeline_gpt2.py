@@ -142,6 +142,14 @@ class GPT2Pipelined(GPT2):
             return pipe_mod.pipe_scattered_loss(x_loc, lab_loc,
                                                 head_fn) + aux
 
+        if pp_sz > 1:
+            pipe_mod.warn_slow_path_once(
+                "gpipe_full_collect",
+                f"GPipe is using the full psum output collect (micro-batch "
+                f"size {mb} not divisible by pp={pp_sz}): the boundary "
+                f"moves the whole [m, mb, T, H] activation volume to every "
+                f"stage instead of 1/pp scatter slices — pad or resize the "
+                f"micro-batch to a multiple of pp for collect='scatter'")
         x, aux = pipe_mod.pipeline_apply(x_micro, stage_fn, with_aux=True)
         # per-micro aux terms are means over their own tokens: average over
         # micros so aux_weight's meaning is independent of m (the LM loss

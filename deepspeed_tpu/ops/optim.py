@@ -159,14 +159,19 @@ class Adam(Optimizer):
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
                weight_decay=None, combined_scale=1.0):
+        from deepspeed_tpu.ops import pallas_optim as pk
+
         step = state.step + 1
+        # shared across every leaf (one pow/sqrt chain, not one per leaf —
+        # the boundary step is a fixed per-optimizer-step cost gas cannot
+        # amortize, so trace-size/kernel-count hygiene here matters)
+        step_f = step.astype(jnp.float32)
 
         def leaf(p, g, m, v, hy):
             if g is None:
                 return p, m, v
             lr_l, b1, b2, wd = self._resolve(*hy)
-            step_size = self._step_size(lr_l, step.astype(jnp.float32),
-                                        b1, b2)
+            step_size = self._step_size(lr_l, step_f, b1, b2)
             # per-ELEMENT hyper arrays (ZeRO x param_groups expands
             # vec[gid] over the flat partition) take the jnp path — the
             # Pallas kernel is compiled for scalar hypers.  Known trade:
@@ -174,7 +179,6 @@ class Adam(Optimizer):
             # kernel variant taking a gid vector would recover it.
             scalar_hy = all(self._is_scalar_hyper(h)
                             for h in (lr_l, b1, b2, wd))
-            from deepspeed_tpu.ops import pallas_optim as pk
             if scalar_hy and pk.should_use_pallas(p.size, self.use_pallas):
                 return pk.fused_adam_update(
                     p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
@@ -216,15 +220,16 @@ class Lamb(Optimizer):
 
     def update(self, params, grads, state, *, lr=None, beta1=None, beta2=None,
                weight_decay=None, combined_scale=1.0):
+        from deepspeed_tpu.ops import pallas_optim as pk
+
         step = state.step + 1
+        step_f = step.astype(jnp.float32)   # shared bias-correction input
 
         def leaf(p, g, m, v, hy):
             if g is None:
                 return p, m, v
             lr_l, b1, b2, wd = self._resolve(*hy)
-            step_size = self._step_size(lr_l, step.astype(jnp.float32),
-                                        b1, b2)
-            from deepspeed_tpu.ops import pallas_optim as pk
+            step_size = self._step_size(lr_l, step_f, b1, b2)
             if pk.should_use_pallas(p.size, self.use_pallas):
                 return pk.fused_lamb_update(
                     p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,

@@ -31,6 +31,7 @@ anywhere (CPU tests).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -191,8 +192,8 @@ def _fused_fwd(q, k, v, attn_mask, causal, interpret):
     return _fwd(q, k, v, attn_mask, causal, interpret), (q, k, v, attn_mask)
 
 
-def _fused_bwd(causal, interpret, res, g):
-    q, k, v, attn_mask = res
+def _block_bwd_impl(q, k, v, attn_mask, g, causal, interpret):
+    """Whole-tile backward on public-layout operands → (dq, dk, dv)."""
     B, T, n, d = q.shape
     qkv_spec, mask_spec, grid = _specs(B, T, n, d, bwd=True)
     scale = 1.0 / (d ** 0.5)
@@ -206,9 +207,15 @@ def _fused_bwd(causal, interpret, res, g):
         out_specs=(qkv_spec, qkv_spec, qkv_spec),
         interpret=interpret,
     )(_hf(q), _hf(k), _hf(v), attn_mask[:, None, :], _hf(g))
-    # mask is a float selector, not a trainable input
     return (jnp.moveaxis(dq, 1, 2), jnp.moveaxis(dk, 1, 2),
-            jnp.moveaxis(dv, 1, 2), jnp.zeros_like(attn_mask))
+            jnp.moveaxis(dv, 1, 2))
+
+
+def _fused_bwd(causal, interpret, res, g):
+    q, k, v, attn_mask = res
+    dq, dk, dv = _block_bwd_impl(q, k, v, attn_mask, g, causal, interpret)
+    # mask is a float selector, not a trainable input
+    return dq, dk, dv, jnp.zeros_like(attn_mask)
 
 
 fused_attention.defvjp(_fused_fwd, _fused_bwd)
@@ -219,13 +226,23 @@ fused_attention.defvjp(_fused_fwd, _fused_bwd)
 # sequences (seq >= 512, where the whole-score-tile kernel above exceeds
 # VMEM).  Standard algebra: the forward keeps a running (row max, denom,
 # accumulator) per query tile and emits the logsumexp; the backward
-# recomputes probabilities from the logsumexp and streams twice — a dK/dV
-# kernel accumulating over query tiles and a dQ kernel accumulating over KV
-# tiles — with delta = rowsum(dO ∘ O) precomputed on the XLA side.
+# recomputes probabilities from the logsumexp block-wise.  Default backward
+# is a SINGLE fused pass over the (kv tile, query tile) grid producing dQ,
+# dK and dV together — the score recompute (QK^T, exp, dP) runs once per
+# tile pair instead of once in a dK/dV kernel and again in a dQ kernel,
+# and q/k/v/do tiles are DMA'd once instead of twice.  dQ accumulates in a
+# full-sequence fp32 VMEM scratch (gb·T·d·4 bytes; gated by
+# ``_fused_bwd_fits`` — oversized shapes fall back to the classic two-pass
+# split, also selectable via DSTPU_STREAM_BWD=fused|split|auto).
+# delta = rowsum(dO ∘ O) is precomputed on the XLA side either way.
 # Layout: [G, T, d] with G = batch * heads folded on the XLA side.
 
 STREAM_TILE = 512      # preferred tile rows per program
 STREAM_TILE_MIN = 256  # fallback when T is not a multiple of 512
+#: fp32 VMEM budget for the fused backward's full-sequence dQ accumulator;
+#: several score tiles + the dK/dV scratch are live next to it, so keep a
+#: healthy margin under the ~16 MB VMEM
+STREAM_DQ_SCRATCH_BUDGET = 4 * 1024 * 1024
 
 
 def _stream_tile(T: int) -> int:
@@ -351,6 +368,70 @@ def _stream_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _stream_bwd_fused_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                             delta_ref, dq_ref, dk_ref, dv_ref,
+                             dq_scr, dk_scr, dv_scr,
+                             *, causal, scale, nq, nk):
+    """Single-pass backward: one sweep of the (kv tile j, query tile i)
+    grid produces dQ, dK AND dV.  The two-kernel split recomputes the
+    score tile (QK^T, exp, dP) once per kernel — 7 T²d matmul passes
+    total; fusing drops that to 5 and halves the q/k/v/do tile DMAs.
+    dK/dV accumulate per parked kv tile (query innermost, as before);
+    dQ accumulates into a full-sequence fp32 scratch sliced at the
+    query-tile offset, written out on the final grid step."""
+    i = pl.program_id(2)     # query tile (innermost)
+    j = pl.program_id(1)     # kv tile
+
+    @pl.when((j == 0) & (i == 0))
+    def _init_dq():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    @pl.when(i == 0)
+    def _init_dkv():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    qt = q_ref.shape[1]
+    kt = k_ref.shape[1]
+
+    def update():
+        q, k, v = q_ref[...], k_ref[...], v_ref[...]
+        do = do_ref[...]
+        p, ds = _recompute_p_ds(q, k, v, do, lse_ref[...][:, 0, :],
+                                delta_ref[...][:, 0, :],
+                                mask_ref[...][:, 0, :], causal, i, j, scale)
+        cdt = q.dtype
+        dsc = ds.astype(cdt)
+        bdims = ((0,), (0,))
+        # contract the QUERY axis: dK += dS^T q ; dV += P^T dO
+        dk_scr[...] += jax.lax.dot_general(
+            dsc, q, (((1,), (1,)), bdims),
+            preferred_element_type=jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(cdt), do, (((1,), (1,)), bdims),
+            preferred_element_type=jnp.float32)
+        # contract the KV axis: dQ[i] += dS k
+        dq_blk = jax.lax.dot_general(
+            dsc, k, (((2,), (1,)), bdims),
+            preferred_element_type=jnp.float32)
+        idx = (slice(None), pl.ds(i * qt, qt), slice(None))
+        pl.store(dq_scr, idx, pl.load(dq_scr, idx) + dq_blk)
+
+    if causal:
+        pl.when(j * kt <= (i + 1) * qt - 1)(update)
+    else:
+        update()
+
+    @pl.when(i == nq - 1)
+    def _fin_dkv():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+    @pl.when((j == nk - 1) & (i == nq - 1))
+    def _fin_dq():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
 def _stream_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                       delta_ref, dq_ref, dq_scr, *, causal, scale, nk):
     j = pl.program_id(2)     # kv tile (innermost)
@@ -406,9 +487,7 @@ def _stream_fwd_impl(q, k, v, attn_mask, causal, interpret):
     nq, nk = T // qt, T // kt
     scale = 1.0 / (d ** 0.5)
     qg, kg, vg = _fold_gtd(q), _fold_gtd(k), _fold_gtd(v)
-    maskg = jnp.broadcast_to(
-        attn_mask.astype(jnp.float32)[:, None, :],
-        (B, n, T)).reshape(G, 1, T)
+    maskg = _mask_gtd(attn_mask, B, T, n)
     q_spec = pl.BlockSpec((gb, qt, d), lambda g, i, j: (g, i, 0))
     kv_spec = pl.BlockSpec((gb, kt, d), lambda g, i, j: (g, j, 0))
     # row vectors ride as [G, 1, T]: Mosaic wants the last two block
@@ -450,23 +529,55 @@ def _stream_vjp_fwd(q, k, v, attn_mask, causal, interpret):
     return _unfold_gtd(o, B, n), (qg, kg, vg, maskg, o, lse, B, n)
 
 
-def _stream_vjp_bwd(causal, interpret, res, g):
-    qg, kg, vg, maskg, o, lse, B, n = res
+def _stream_bwd_mode() -> str:
+    mode = os.environ.get("DSTPU_STREAM_BWD", "auto")
+    if mode not in ("auto", "fused", "split"):
+        raise ValueError(
+            f"DSTPU_STREAM_BWD={mode!r} is not a valid mode: use 'auto' "
+            f"(fused single-pass when the dQ scratch fits VMEM), 'fused', "
+            f"or 'split' (classic two-kernel backward)")
+    return mode
+
+
+def _fused_bwd_fits(gb: int, T: int, d: int) -> bool:
+    return gb * T * d * 4 <= STREAM_DQ_SCRATCH_BUDGET
+
+
+def _stream_bwd_impl(qg, kg, vg, maskg, o, lse, dog, causal, interpret):
+    """Streaming backward on folded [G, T, d] operands → (dq, dk, dv),
+    same layout.  Fused single pass by default; the two-kernel split
+    remains as the escape hatch / large-shape fallback."""
     G, T, d = qg.shape
     gb = _stream_gb(G)
     qt = kt = _stream_tile(T)
     nq, nk = T // qt, T // kt
     scale = 1.0 / (d ** 0.5)
-    dog = _fold_gtd(g)
     delta = jnp.sum(dog.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]                    # [G, 1, T]
-    q_spec = pl.BlockSpec((gb, qt, d), lambda g_, i, j: (g_, i, 0))
-    row_spec = pl.BlockSpec((gb, 1, qt), lambda g_, i, j: (g_, 0, i))
-    # dK/dV: grid (G, kv tile, query tile) — query innermost, kv parked
+    # grid (G, kv tile, query tile) — query innermost, kv parked
     kv_spec_o = pl.BlockSpec((gb, kt, d), lambda g_, j, i: (g_, j, 0))
     mask_spec_o = pl.BlockSpec((gb, 1, kt), lambda g_, j, i: (g_, 0, j))
     q_spec_o = pl.BlockSpec((gb, qt, d), lambda g_, j, i: (g_, i, 0))
     row_spec_o = pl.BlockSpec((gb, 1, qt), lambda g_, j, i: (g_, 0, i))
+    mode = _stream_bwd_mode()
+    if mode == "fused" or (mode == "auto" and _fused_bwd_fits(gb, T, d)):
+        dq_spec = pl.BlockSpec((gb, T, d), lambda g_, j, i: (g_, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_stream_bwd_fused_kernel, causal=causal,
+                              scale=scale, nq=nq, nk=nk),
+            out_shape=(jax.ShapeDtypeStruct((G, T, d), qg.dtype),
+                       jax.ShapeDtypeStruct((G, T, d), kg.dtype),
+                       jax.ShapeDtypeStruct((G, T, d), vg.dtype)),
+            grid=(G // gb, nk, nq),
+            in_specs=[q_spec_o, kv_spec_o, kv_spec_o, mask_spec_o,
+                      q_spec_o, row_spec_o, row_spec_o],
+            out_specs=(dq_spec, kv_spec_o, kv_spec_o),
+            scratch_shapes=[pltpu.VMEM((gb, T, d), jnp.float32),
+                            pltpu.VMEM((gb, kt, d), jnp.float32),
+                            pltpu.VMEM((gb, kt, d), jnp.float32)],
+            interpret=interpret,
+        )(qg, kg, vg, maskg, dog, lse, delta)
+        return dq, dk, dv
     dk, dv = pl.pallas_call(
         functools.partial(_stream_dkv_kernel, causal=causal, scale=scale,
                           nq=nq),
@@ -481,6 +592,8 @@ def _stream_vjp_bwd(causal, interpret, res, g):
         interpret=interpret,
     )(qg, kg, vg, maskg, dog, lse, delta)
     # dQ: grid (G, query tile, kv tile) — kv innermost
+    q_spec = pl.BlockSpec((gb, qt, d), lambda g_, i, j: (g_, i, 0))
+    row_spec = pl.BlockSpec((gb, 1, qt), lambda g_, i, j: (g_, 0, i))
     kv_spec = pl.BlockSpec((gb, kt, d), lambda g_, i, j: (g_, j, 0))
     mask_spec = pl.BlockSpec((gb, 1, kt), lambda g_, i, j: (g_, 0, j))
     dq = pl.pallas_call(
@@ -494,12 +607,138 @@ def _stream_vjp_bwd(causal, interpret, res, g):
         scratch_shapes=[pltpu.VMEM((gb, qt, d), jnp.float32)],
         interpret=interpret,
     )(qg, kg, vg, maskg, dog, lse, delta)
+    return dq, dk, dv
+
+
+def _stream_vjp_bwd(causal, interpret, res, g):
+    qg, kg, vg, maskg, o, lse, B, n = res
+    dq, dk, dv = _stream_bwd_impl(qg, kg, vg, maskg, o, lse, _fold_gtd(g),
+                                  causal, interpret)
+    T = qg.shape[1]
     # the mask is a float selector, not a trainable input
     return (_unfold_gtd(dq, B, n), _unfold_gtd(dk, B, n),
             _unfold_gtd(dv, B, n), jnp.zeros((B, T), jnp.float32))
 
 
 stream_attention.defvjp(_stream_vjp_fwd, _stream_vjp_bwd)
+
+
+# ==================================================================== hybrid
+# Forward and backward chosen INDEPENDENTLY per (seq, kind): the end-to-end
+# sweeps (bench_attn_sweep.json) measure fwd+bwd together, but the two
+# passes have different crossovers — the backward streams 5 matmul passes
+# per tile pair against the forward's 2, so the kernel's DMA savings pay
+# off earlier there.  ``dispatch_attention`` is the custom-VJP shell that
+# lets models/layers.py pick {"xla", "block", "stream"} per direction; the
+# single-impl cases degenerate to the kernels above.
+
+ATTN_IMPLS = ("xla", "block", "stream")
+
+
+def _check_impls(fwd_impl: str, bwd_impl: str) -> None:
+    if fwd_impl not in ATTN_IMPLS or bwd_impl not in ATTN_IMPLS:
+        raise ValueError(
+            f"attention impls must be one of {ATTN_IMPLS}, got "
+            f"fwd={fwd_impl!r} bwd={bwd_impl!r}")
+    if bwd_impl == "stream" and fwd_impl == "block":
+        raise ValueError(
+            "bwd_impl='stream' needs the forward logsumexp, which the "
+            "whole-tile kernel does not emit — use fwd_impl 'stream' or "
+            "'xla'")
+
+
+def xla_attention(q, k, v, attn_mask, causal, with_lse=False):
+    """Plain-XLA attention (the models/layers.py einsum path), optionally
+    emitting the logsumexp in the streaming kernels' [G, 1, T] layout so a
+    streaming backward can follow an XLA forward."""
+    B, T, n, d = q.shape
+    scores = jnp.einsum("btnd,bsnd->bnts", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        cmask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        scores = jnp.where(cmask[None, None], scores, -1e9)
+    scores = jnp.where(attn_mask[:, None, None, :].astype(jnp.bool_),
+                       scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bnts,bsnd->btnd", probs, v)
+    if not with_lse:
+        return out, None
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)      # [B, n, T]
+    return out, lse.reshape(B * n, 1, T)
+
+
+def _mask_gtd(attn_mask, B, T, n):
+    return jnp.broadcast_to(
+        attn_mask.astype(jnp.float32)[:, None, :], (B, n, T)
+    ).reshape(B * n, 1, T)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def dispatch_attention(q, k, v, attn_mask, causal: bool = False,
+                       fwd_impl: str = "xla", bwd_impl: str = "xla",
+                       interpret: bool = False):
+    """Attention with independently chosen forward/backward kernels.
+
+    q/k/v: [B, T, n, d]; attn_mask: [B, T] float (1 = attend).  The impls
+    are {"xla", "block", "stream"}; bwd "stream" after fwd "block" is
+    rejected (no logsumexp).  Callers gate shapes via ``supported`` /
+    ``stream_supported`` per impl."""
+    _check_impls(fwd_impl, bwd_impl)
+    B, _, n, _ = q.shape
+    if fwd_impl == "stream":
+        o, _, _ = _stream_fwd_impl(q, k, v, attn_mask, causal, interpret)
+        return _unfold_gtd(o, B, n)
+    if fwd_impl == "block":
+        return _fwd(q, k, v, attn_mask, causal, interpret)
+    return xla_attention(q, k, v, attn_mask, causal)[0]
+
+
+def _dispatch_vjp_fwd(q, k, v, attn_mask, causal, fwd_impl, bwd_impl,
+                      interpret):
+    _check_impls(fwd_impl, bwd_impl)
+    B, T, n, d = q.shape
+    need_stream_res = bwd_impl == "stream"
+    extra = None
+    if fwd_impl == "stream":
+        o, lse, _ = _stream_fwd_impl(q, k, v, attn_mask, causal, interpret)
+        out = _unfold_gtd(o, B, n)
+        if need_stream_res:
+            extra = (o, lse)
+    elif fwd_impl == "block":
+        out = _fwd(q, k, v, attn_mask, causal, interpret)
+    else:
+        out, lse = xla_attention(q, k, v, attn_mask, causal,
+                            with_lse=need_stream_res)
+        if need_stream_res:
+            extra = (_fold_gtd(out), lse)
+    return out, (q, k, v, attn_mask, extra)
+
+
+def _dispatch_vjp_bwd(causal, fwd_impl, bwd_impl, interpret, res, g):
+    q, k, v, attn_mask, extra = res
+    B, T, n, d = q.shape
+    if bwd_impl == "stream":
+        o, lse = extra
+        dq, dk, dv = _stream_bwd_impl(
+            _fold_gtd(q), _fold_gtd(k), _fold_gtd(v),
+            _mask_gtd(attn_mask, B, T, n), o, lse, _fold_gtd(g),
+            causal, interpret)
+        dq, dk, dv = (_unfold_gtd(x, B, n) for x in (dq, dk, dv))
+    elif bwd_impl == "block":
+        dq, dk, dv = _block_bwd_impl(q, k, v, attn_mask, g, causal,
+                                     interpret)
+    else:
+        # XLA backward: recompute-and-differentiate the einsum forward
+        # (the same work a remat'd XLA attention does in the replay)
+        _, pull = jax.vjp(
+            lambda q_, k_, v_: xla_attention(q_, k_, v_, attn_mask, causal)[0],
+            q, k, v)
+        dq, dk, dv = pull(g)
+    return dq, dk, dv, jnp.zeros_like(attn_mask)
+
+
+dispatch_attention.defvjp(_dispatch_vjp_fwd, _dispatch_vjp_bwd)
 
 
 def calibrate_stream_threshold(seq_lens=(256, 512, 1024, 2048),
@@ -578,8 +817,9 @@ def calibrate_stream_threshold(seq_lens=(256, 512, 1024, 2048),
         # just showed the kernel losing, so fall back to the table/default
         # (the calibration loss is causal, so read the causal column)
         kind = jax.devices()[0].device_kind
-        pair = _L.STREAM_AUTO_MIN_BY_KIND.get(kind)
-        threshold = pair[0] if pair else _L.STREAM_AUTO_MIN_CAUSAL
+        entry = _L.STREAM_AUTO_MIN_BY_KIND.get(kind)
+        threshold = (min(entry["causal"]) if entry
+                     else _L.STREAM_AUTO_MIN_CAUSAL)
         if verbose:
             print(f"kernel never won >=1.05x; keeping {threshold}")
     elif verbose:

@@ -133,6 +133,9 @@ class ThroughputTimer:
         self.local_step_count = 0
         self.total_step_count = 0
         self.total_elapsed_time = 0.0
+        self._window_start = None   # first start() since the last report
+        self._window_steps = 0      # steps in the open window
+        self._counted_steps = 0     # steps folded into total_elapsed_time
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory and PSUTIL_AVAILABLE
         self.logging = logging_fn or logger.info
@@ -150,19 +153,43 @@ class ThroughputTimer:
         self.started = True
         if self.total_step_count >= self.start_step:
             self.start_time = time.time()
+            if self._window_start is None:
+                self._window_start = self.start_time
 
     def stop(self, report_speed: bool = True, sync_on=None):
+        """End-of-step tick.  ``sync_on`` is fenced ONLY on steps that
+        actually report (every ``steps_per_output``): fencing every step
+        would serialize host dispatch with device execution — one full
+        device round-trip of latency per optimizer step, a fixed cost
+        gradient accumulation cannot amortize (the engine's fused
+        train_batch queues steps asynchronously precisely to avoid it).
+
+        Accounting is therefore WINDOW-based: elapsed time accumulates
+        only at report fences, as (fence time − first start() of the
+        window), covering every step queued in between — including any
+        host time the caller spent blocking on losses, which device
+        execution overlaps.  Unfenced per-step durations (dispatch-only
+        under async queuing) are never summed, so the printed
+        SamplesPerSec is the true end-to-end rate over each report
+        window rather than an inflated dispatch rate.  The window ALSO
+        spans any other host work between steps; callers interleaving
+        non-training work (eval, synchronous saves) should
+        ``discard_window()`` first — the engine does."""
         if not self.started:
             return
         self.started = False
         self.total_step_count += 1
         self.local_step_count += 1
         if self.total_step_count > self.start_step:
-            _fence(sync_on)
-            self.end_time = time.time()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            if report_speed and self.local_step_count % self.steps_per_output == 0:
+            self._window_steps += 1
+            if (report_speed
+                    and self.local_step_count % self.steps_per_output == 0):
+                _fence(sync_on)
+                self.end_time = time.time()
+                self.total_elapsed_time += self.end_time - self._window_start
+                self._counted_steps += self._window_steps
+                self._window_start = None
+                self._window_steps = 0
                 self.logging(
                     f"{self.epoch_count}/{self.local_step_count}, "
                     f"SamplesPerSec={self.avg_samples_per_sec():.3f}")
@@ -173,10 +200,28 @@ class ThroughputTimer:
                         f"vm percent: {vm.percent}, swap percent: "
                         f"{psutil.swap_memory().percent}")
 
+    def discard_window(self):
+        """Drop the open (unreported) measurement window.  Call before
+        non-training work on the same host thread — eval passes,
+        synchronous checkpoint saves, epoch turnarounds — which would
+        otherwise be folded into the next report's elapsed time and
+        deflate its SamplesPerSec.  The discarded steps simply go
+        uncounted."""
+        self._window_start = None
+        self._window_steps = 0
+
     def avg_samples_per_sec(self) -> float:
-        if self.total_step_count > self.start_step:
+        """Cumulative rate over all fenced report windows.  When no
+        report has fired yet (short runs, reporting muted), the OPEN
+        window is folded in using plain wall time — an unfenced
+        approximation (queued device work may still be draining), but a
+        usable rate instead of no answer."""
+        elapsed = self.total_elapsed_time
+        steps = self._counted_steps
+        if self._window_start is not None and self._window_steps > 0:
+            elapsed += time.time() - self._window_start
+            steps += self._window_steps
+        if steps > 0 and elapsed > 0.0:
             samples_per_step = self.batch_size * self.num_workers
-            total_step_offset = self.total_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / total_step_offset
-            return samples_per_step / avg_time_per_step
+            return samples_per_step / (elapsed / steps)
         return float("-inf")

@@ -31,6 +31,10 @@ if os.environ.get("_DSTPU_TEST_ENV") != "1":
 
 import pytest  # noqa: E402  (post-re-exec: safe to import)
 
+import deepspeed_tpu  # noqa: E402,F401  (installs the jax compat shims —
+# tests use jax.shard_map directly, which older jax only has under
+# jax.experimental; deepspeed_tpu.compat bridges both spellings)
+
 
 def pytest_collection_modifyitems(config, items):
     """Tier markers by location: tests/model/ is the 300-step convergence

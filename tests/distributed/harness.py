@@ -24,9 +24,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(os.path.dirname(HERE))
 WORKER_MAIN = os.path.join(HERE, "worker_main.py")
 
-# grace period after the first worker exits before stragglers are killed
-# (reference common.py joins remaining procs with a 10 s timeout)
-GRACE = 20.0
+# straggler window after the FIRST worker exits (reference common.py joins
+# remaining procs with a 10 s timeout).  Must absorb a full jit
+# compile + gloo handshake on a loaded single-core CI box (the full suite
+# runs several such spawns back to back); a genuinely hung worker is still
+# bounded by the overall per-spawn timeout.
+GRACE = float(os.environ.get("DSTPU_TEST_GRACE", "120"))
 
 
 def free_port() -> int:
@@ -54,15 +57,43 @@ def worker_env(pid: int, world_size: int, port: int, local_devices: int,
     return env
 
 
+#: transport-level gloo failures that are INFRA flakes, not test logic:
+#: under full-suite load on a 1-core box the gloo TCP pair occasionally
+#: corrupts mid-stream ("op.preamble.length <= op.nbytes") and the peer
+#: dies on the coordination-service poll.  Bounded retries on fresh
+#: ports; exhausting them (or any non-transport failure) surfaces
+#: normally.  (init_distributed already disables CPU async dispatch under
+#: gloo, which removes most of these.)
+_GLOO_FLAKE_MARKER = "gloo::EnforceNotMet"
+
+
 def spawn_distributed(func_name: str, world_size: int = 2,
                       local_devices: int = 2, timeout: float = 420.0,
-                      env_extra: dict | None = None) -> list:
+                      env_extra: dict | None = None,
+                      _retries_left: int = 2) -> list:
     """Run ``workers.<func_name>()`` in ``world_size`` real processes.
 
     Returns the per-process stdout+stderr text (asserting success);
     raises AssertionError with all captured output on any failure, timeout,
-    or missing completion sentinel.
+    or missing completion sentinel.  A gloo TCP transport flake (see
+    ``_GLOO_FLAKE_MARKER``) is retried (twice) on fresh ports.
     """
+    try:
+        return _spawn_distributed_once(func_name, world_size, local_devices,
+                                       timeout, env_extra)
+    except AssertionError as e:
+        if _retries_left > 0 and _GLOO_FLAKE_MARKER in str(e):
+            print(f"spawn_distributed({func_name!r}): gloo transport flake, "
+                  f"retrying on a fresh port "
+                  f"({_retries_left} retries left)", file=sys.stderr)
+            return spawn_distributed(func_name, world_size, local_devices,
+                                     timeout, env_extra,
+                                     _retries_left=_retries_left - 1)
+        raise
+
+
+def _spawn_distributed_once(func_name, world_size, local_devices, timeout,
+                            env_extra) -> list:
     import tempfile
 
     port = free_port()

@@ -19,7 +19,8 @@ import pytest
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, HERE)
 
-from harness import REPO, free_port, spawn_distributed, worker_env  # noqa: E402
+from harness import (REPO, _GLOO_FLAKE_MARKER, free_port,  # noqa: E402
+                     spawn_distributed, worker_env)
 
 pytestmark = pytest.mark.distributed
 
@@ -113,10 +114,15 @@ E2E_SCRIPT = textwrap.dedent("""\
         engine.backward(loss)
         engine.step()
     engine.save_checkpoint(os.environ["DSTPU_E2E_CKPT"], tag="e2e")
-    print("E2E_ENV_MARKER", os.environ.get("DSTPU_EXTRA_MARKER", "<unset>"),
-          flush=True)
-    print(f"E2E_OK rank={{jax.process_index()}} loss={{float(loss):.6f}}",
-          flush=True)
+    # one atomic write per sentinel: multi-arg print issues several
+    # os.writes, and two ranks sharing the launcher's pipe can interleave
+    # mid-line under load, corrupting the exact substrings the test greps
+    sys.stdout.write("E2E_ENV_MARKER "
+                     + os.environ.get("DSTPU_EXTRA_MARKER", "<unset>")
+                     + "\\n")
+    sys.stdout.write(
+        f"E2E_OK rank={{jax.process_index()}} loss={{float(loss):.6f}}\\n")
+    sys.stdout.flush()
 """)
 
 
@@ -394,13 +400,24 @@ def test_dst_loss_parity(label, mp, extra, tol, tmpdir):
     env["DSTPU_PARITY_MP"] = str(mp)
     env["DSTPU_PARITY_OUT"] = str(out_file)
 
-    cmd = [sys.executable, os.path.join(REPO, "bin", "dst"),
-           "--launcher", "local", "--num_chips", "2",
-           f"--master_port={port}",
-           str(script), "--deepspeed", f"--deepspeed_config={cfg}"]
-    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                          text=True, timeout=420)
-    out = proc.stdout + proc.stderr
+    for attempt in (1, 2, 3):
+        cmd = [sys.executable, os.path.join(REPO, "bin", "dst"),
+               "--launcher", "local", "--num_chips", "2",
+               f"--master_port={port}",
+               str(script), "--deepspeed", f"--deepspeed_config={cfg}"]
+        proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                              text=True, timeout=420)
+        out = proc.stdout + proc.stderr
+        if (proc.returncode != 0 and attempt < 3
+                and _GLOO_FLAKE_MARKER in out):
+            # gloo TCP pair teardown race (same transport flake
+            # harness.spawn_distributed retries): infra, not launcher
+            # logic — once, on a fresh port
+            print("dst gloo transport flake; retrying on a fresh port",
+                  file=sys.stderr)
+            port = free_port()
+            continue
+        break
     assert proc.returncode == 0, f"dst exited {proc.returncode}:\n{out}"
     assert "PARITY_OK" in out, out
 

@@ -1,0 +1,191 @@
+"""Sparse masked-position MLM head: gather-vs-dense equivalence.
+
+The maxpred-80 head at seq 512 is a top-three phase-2 cost
+(bench_mfu_breakdown.json); the sparse path gathers the masked positions
+BEFORE the vocab projection.  These tests pin:
+
+* ``layers.gather_positions`` — the one-hot-matmul gather (scatter-free
+  VJP, the TPU form) against ``take_along_axis``, forward and gradient;
+* the dense-labels format with ``mlm_gather_budget`` against the plain
+  dense head, including the all-positions-masked and zero-masked edge
+  cases and the documented overflow contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import BertForPreTraining
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.parallel.topology import make_mesh
+
+VOCAB, SEQ = 64, 16
+B = 8   # the test mesh has 8 fake devices on the data axis
+
+
+def tiny_bert(**over):
+    return BertForPreTraining.from_size(
+        "tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+        num_layers=1, hidden_size=16, num_heads=2, **over)
+
+
+# ------------------------------------------------------- gather_positions
+
+def test_gather_positions_onehot_matches_take(monkeypatch):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, SEQ, 8)).astype(np.float32))
+    pos = jnp.asarray(rng.integers(0, SEQ, size=(B, 5)).astype(np.int32))
+
+    monkeypatch.setenv("DSTPU_MLM_GATHER", "take")
+    want = L.gather_positions(x, pos)
+    g_take = jax.grad(lambda x: jnp.sum(jnp.sin(
+        L.gather_positions(x, pos))))(x)
+    monkeypatch.setenv("DSTPU_MLM_GATHER", "onehot")
+    got = L.gather_positions(x, pos)
+    g_onehot = jax.grad(lambda x: jnp.sum(jnp.sin(
+        L.gather_positions(x, pos))))(x)
+
+    # one-hot selection is exact (one nonzero term per output element),
+    # including repeated positions (the VJP scatter-adds either way)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(g_onehot), np.asarray(g_take),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gather_positions_mode_validation(monkeypatch):
+    monkeypatch.setenv("DSTPU_MLM_GATHER", "scatter")
+    with pytest.raises(ValueError, match="DSTPU_MLM_GATHER"):
+        L.gather_positions(jnp.zeros((1, 4, 2)), jnp.zeros((1, 1), jnp.int32))
+
+
+# --------------------------------------------- dense-labels sparse budget
+
+def _loss_fn(model, params, batch, mesh):
+    specs = model.partition_specs(params)
+    fn = jax.jit(jax.shard_map(
+        lambda p, *b: model.apply(p, *b), mesh=mesh,
+        in_specs=(specs,) + tuple(P("data", None) for _ in batch),
+        out_specs=P(), check_vma=False))
+    return fn(params, *batch)
+
+
+def _bert_inputs(mlm_dense):
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, VOCAB, size=(B, SEQ)).astype(np.int32)
+    mask = np.ones((B, SEQ), np.int32)
+    mask[:, SEQ - 3:] = 0
+    tt = np.zeros((B, SEQ), np.int32)
+    return (ids, mask, tt, mlm_dense)
+
+
+@pytest.mark.parametrize("budget", [6, SEQ, SEQ + 50])
+def test_sparse_budget_matches_dense(budget):
+    """Within-budget masked counts: sparse gather == dense head, loss and
+    parameter gradients (budget > T exercises the clamp)."""
+    rng = np.random.default_rng(5)
+    mlm = np.full((B, SEQ), -1, np.int32)
+    for b in range(B):
+        pos = rng.choice(SEQ, size=4, replace=False)
+        mlm[b, pos] = rng.integers(0, VOCAB, size=4)
+    batch = _bert_inputs(mlm)
+    mesh = make_mesh(model_parallel_size=1)
+
+    dense_m = tiny_bert()
+    sparse_m = tiny_bert(mlm_gather_budget=budget)
+    params = dense_m.init_params(jax.random.PRNGKey(0))
+
+    want = float(_loss_fn(dense_m, params, batch, mesh))
+    got = float(_loss_fn(sparse_m, params, batch, mesh))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    g_dense = jax.grad(lambda p: _loss_fn(dense_m, p, batch, mesh))(params)
+    g_sparse = jax.grad(lambda p: _loss_fn(sparse_m, p, batch, mesh))(params)
+    flat_d = jax.tree_util.tree_leaves(g_dense)
+    flat_s = jax.tree_util.tree_leaves(g_sparse)
+    for a, b_ in zip(flat_s, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_sparse_budget_all_positions_masked():
+    """Every position masked: budget >= T keeps the gather an exact
+    permutation of the dense head."""
+    rng = np.random.default_rng(6)
+    mlm = rng.integers(0, VOCAB, size=(B, SEQ)).astype(np.int32)
+    batch = _bert_inputs(mlm)
+    mesh = make_mesh(model_parallel_size=1)
+    params = tiny_bert().init_params(jax.random.PRNGKey(1))
+    want = float(_loss_fn(tiny_bert(), params, batch, mesh))
+    got = float(_loss_fn(tiny_bert(mlm_gather_budget=SEQ), params, batch,
+                         mesh))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_sparse_budget_zero_masked():
+    """No masked positions: both paths degrade to a zero loss (the
+    max(count, 1) guard), not a NaN."""
+    mlm = np.full((B, SEQ), -1, np.int32)
+    batch = _bert_inputs(mlm)
+    mesh = make_mesh(model_parallel_size=1)
+    params = tiny_bert().init_params(jax.random.PRNGKey(2))
+    want = float(_loss_fn(tiny_bert(), params, batch, mesh))
+    got = float(_loss_fn(tiny_bert(mlm_gather_budget=4), params, batch,
+                         mesh))
+    assert np.isfinite(got)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert got == 0.0
+
+
+def test_engine_switches_mlm_batch_formats():
+    """The fused train_batch program is keyed on batch STRUCTURE: a BERT
+    engine fed masked-positions batches must accept a dense-labels batch
+    next (different leaf count -> different shard_map in_specs) instead
+    of failing on a spec/pytree mismatch."""
+    import deepspeed_tpu
+
+    model = tiny_bert(mlm_gather_budget=SEQ)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": B, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=make_mesh(model_parallel_size=1))
+
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, VOCAB, size=(B, SEQ)).astype(np.int32)
+    mask = np.ones((B, SEQ), np.int32)
+    tt = np.zeros((B, SEQ), np.int32)
+    pos = np.stack([np.sort(rng.choice(SEQ, size=4, replace=False))
+                    for _ in range(B)]).astype(np.int32)
+    mids = np.take_along_axis(ids, pos, axis=1)
+    w = np.ones((B, 4), np.float32)
+    dense = np.full((B, SEQ), -1, np.int32)
+    np.put_along_axis(dense, pos, mids, axis=1)
+
+    l_pos = float(engine.train_batch((ids, mask, tt, pos, mids, w)))
+    l_dense = float(engine.train_batch((ids, mask, tt, dense)))
+    l_pos2 = float(engine.train_batch((ids, mask, tt, pos, mids, w)))
+    assert np.isfinite(l_pos) and np.isfinite(l_dense) and np.isfinite(l_pos2)
+
+
+def test_sparse_budget_overflow_contract():
+    """Masked counts past the budget: the documented contract drops the
+    LAST overflow positions (top_k is stable), i.e. the loss equals the
+    dense loss over each row's first ``budget`` masked positions."""
+    rng = np.random.default_rng(7)
+    mlm = rng.integers(0, VOCAB, size=(B, SEQ)).astype(np.int32)  # all masked
+    batch = _bert_inputs(mlm)
+    budget = 5
+    mesh = make_mesh(model_parallel_size=1)
+    params = tiny_bert().init_params(jax.random.PRNGKey(3))
+
+    got = float(_loss_fn(tiny_bert(mlm_gather_budget=budget), params,
+                         batch, mesh))
+    truncated = np.full((B, SEQ), -1, np.int32)
+    truncated[:, :budget] = mlm[:, :budget]
+    want = float(_loss_fn(tiny_bert(), params,
+                          _bert_inputs(truncated), mesh))
+    np.testing.assert_allclose(got, want, rtol=1e-5)

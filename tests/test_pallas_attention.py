@@ -194,7 +194,10 @@ def test_stream_threshold_resolution(monkeypatch):
     dependent and must be re-pinnable without a code change)."""
     from deepspeed_tpu.models import layers as L
 
-    monkeypatch.delenv("DSTPU_STREAM_ATTN_MIN", raising=False)
+    for name in ("DSTPU_STREAM_ATTN_MIN", "DSTPU_STREAM_ATTN_MIN_CAUSAL",
+                 "DSTPU_STREAM_ATTN_MIN_BWD",
+                 "DSTPU_STREAM_ATTN_MIN_CAUSAL_BWD"):
+        monkeypatch.delenv(name, raising=False)
     kind = jax.devices()[0].device_kind
     # CPU test rig: kind not in the table -> the measured defaults,
     # causal-aware (causal crossover is lower: the streaming kernel skips
@@ -203,13 +206,18 @@ def test_stream_threshold_resolution(monkeypatch):
         assert L.stream_auto_min() == L.STREAM_AUTO_MIN
         assert L.stream_auto_min(causal=True) == L.STREAM_AUTO_MIN_CAUSAL
 
-    monkeypatch.setitem(L.STREAM_AUTO_MIN_BY_KIND, kind, (256, 512))
+    monkeypatch.setitem(L.STREAM_AUTO_MIN_BY_KIND, kind,
+                        {"causal": (256, 128), "noncausal": (512, 384)})
     assert L.stream_auto_min(causal=True) == 256   # table wins default
     assert L.stream_auto_min() == 512
+    # forward and backward resolve independently from the table
+    assert L.stream_auto_min(causal=True, direction="bwd") == 128
+    assert L.stream_auto_min(direction="bwd") == 384
 
     monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN", "2048")
     assert L.stream_auto_min() == 2048         # env pin wins everything
     assert L.stream_auto_min(causal=True) == 2048
+    assert L.stream_auto_min(causal=True, direction="bwd") == 2048
 
     # the causal-scoped pin (what calibrate() prints) never leaks into
     # non-causal dispatch — a causal-measured crossover would force the
@@ -218,9 +226,158 @@ def test_stream_threshold_resolution(monkeypatch):
     assert L.stream_auto_min(causal=True) == 256
     assert L.stream_auto_min() == 2048
 
+    # direction-scoped pins beat the direction-blind ones for their
+    # direction only
+    monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN_CAUSAL_BWD", "128")
+    assert L.stream_auto_min(causal=True, direction="bwd") == 128
+    assert L.stream_auto_min(causal=True) == 256
+    monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN_BWD", "512")
+    assert L.stream_auto_min(direction="bwd") == 512
+    assert L.stream_auto_min() == 2048
+
     monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN", "-3")
-    with pytest.raises(ValueError, match="positive"):
+    with pytest.raises(ValueError, match="non-negative"):
         L.stream_auto_min()
+    monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN", "2048")
+    with pytest.raises(ValueError, match="'fwd' or 'bwd'"):
+        L.stream_auto_min(direction="sideways")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_stream_backward_fused_matches_split(monkeypatch, causal):
+    """The single-pass fused backward (dQ/dK/dV in one kernel) must match
+    the classic two-kernel split bit-for-tolerance — same tile math, only
+    the recompute count and accumulation order differ."""
+    q, k, v = stream_qkv(seed=11)
+    mask = np.ones((2, ST), np.float32)
+    mask[:, ST - 41:] = 0.0
+    mask = jnp.asarray(mask)
+
+    def grads():
+        return jax.grad(lambda q, k, v: jnp.sum(jnp.tanh(
+            pattn.stream_attention(q, k, v, mask, causal, True))),
+            (0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("DSTPU_STREAM_BWD", "fused")
+    g_fused = grads()
+    monkeypatch.setenv("DSTPU_STREAM_BWD", "split")
+    g_split = grads()
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_stream_bwd_mode_validation(monkeypatch):
+    monkeypatch.setenv("DSTPU_STREAM_BWD", "sideways")
+    with pytest.raises(ValueError, match="DSTPU_STREAM_BWD"):
+        pattn._stream_bwd_mode()
+    monkeypatch.delenv("DSTPU_STREAM_BWD")
+    assert pattn._stream_bwd_mode() == "auto"
+    # the auto gate: dQ scratch must fit the VMEM budget
+    assert pattn._fused_bwd_fits(2, 512, 64)
+    assert not pattn._fused_bwd_fits(2, 64 * 1024, 64)
+
+
+# ------------------------------------------------- hybrid fwd/bwd dispatch
+
+STREAM_COMBOS = [("stream", "stream"), ("stream", "xla"), ("xla", "stream")]
+BLOCK_COMBOS = [("block", "block"), ("block", "xla"), ("xla", "block")]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("fwd_impl,bwd_impl", STREAM_COMBOS)
+def test_dispatch_stream_combos_parity(causal, fwd_impl, bwd_impl):
+    """Mixed forward/backward kernel choices (the per-direction dispatch
+    table) agree with the all-XLA reference at seq 512, fwd AND grad."""
+    q, k, v = stream_qkv(seed=5)
+    mask = np.ones((2, ST), np.float32)
+    mask[:, ST - 23:] = 0.0
+    mask = jnp.asarray(mask)
+
+    def loss_d(q, k, v):
+        return jnp.sum(jnp.sin(pattn.dispatch_attention(
+            q, k, v, mask, causal, fwd_impl, bwd_impl, True)))
+
+    def loss_r(q, k, v):
+        return jnp.sum(jnp.sin(stream_reference(q, k, v, mask, causal)))
+
+    np.testing.assert_allclose(
+        np.asarray(pattn.dispatch_attention(q, k, v, mask, causal,
+                                            fwd_impl, bwd_impl, True)),
+        np.asarray(stream_reference(q, k, v, mask, causal)),
+        rtol=2e-5, atol=2e-5)
+    gd = jax.grad(loss_d, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("fwd_impl,bwd_impl", BLOCK_COMBOS)
+def test_dispatch_block_combos_parity(causal, fwd_impl, bwd_impl):
+    q, k, v = rand_qkv(seed=6)
+    mask = pad_mask()
+
+    def loss_d(q, k, v):
+        return jnp.sum(jnp.cos(pattn.dispatch_attention(
+            q, k, v, mask, causal, fwd_impl, bwd_impl, True)))
+
+    def loss_r(q, k, v):
+        return jnp.sum(jnp.cos(reference(q, k, v, mask, causal)))
+
+    np.testing.assert_allclose(
+        np.asarray(pattn.dispatch_attention(q, k, v, mask, causal,
+                                            fwd_impl, bwd_impl, True)),
+        np.asarray(reference(q, k, v, mask, causal)),
+        rtol=1e-5, atol=1e-5)
+    gd = jax.grad(loss_d, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_dispatch_rejects_block_then_stream():
+    q, k, v = rand_qkv()
+    mask = jnp.ones((B, T), jnp.float32)
+    with pytest.raises(ValueError, match="logsumexp"):
+        pattn.dispatch_attention(q, k, v, mask, False, "block", "stream",
+                                 True)
+    with pytest.raises(ValueError, match="impls must be one of"):
+        pattn.dispatch_attention(q, k, v, mask, False, "nope", "xla", True)
+
+
+def test_attention_plan_directions(monkeypatch):
+    """The auto plan resolves forward and backward independently, uses the
+    whole-tile kernel for short causal shapes (the committed seq-128 causal
+    sweep row), and keeps XLA for short non-causal shapes."""
+    from deepspeed_tpu.models import layers as L
+
+    for name in ("DSTPU_STREAM_ATTN_MIN", "DSTPU_STREAM_ATTN_MIN_CAUSAL",
+                 "DSTPU_STREAM_ATTN_MIN_BWD", "DSTPU_FUSED_ATTN",
+                 "DSTPU_STREAM_ATTN_MIN_CAUSAL_BWD",
+                 "DSTPU_BLOCK_ATTN_MIN_CAUSAL"):
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN_CAUSAL", "1024")
+    monkeypatch.setenv("DSTPU_STREAM_ATTN_MIN_CAUSAL_BWD", "512")
+    # seq 512 causal, 12 heads d64: stream supported; only the backward
+    # threshold admits it; whole-tile kernel doesn't fit 512 -> fwd XLA
+    assert L.attention_plan(512, 12, 64, causal=True) == ("xla", "stream")
+    # seq 128 causal: below both stream tiles -> the whole-tile kernel
+    # from the sweep (1.127x) both directions
+    assert L.attention_plan(128, 12, 64, causal=True) == ("block", "block")
+    monkeypatch.setenv("DSTPU_BLOCK_ATTN_MIN_CAUSAL", "0")
+    assert L.attention_plan(128, 12, 64, causal=True) == ("xla", "xla")
+    # non-causal short: XLA (0.92x measured) regardless of block support
+    assert L.attention_plan(128, 16, 64, causal=False) == ("xla", "xla")
+    # force mode: one kernel, both directions
+    monkeypatch.setenv("DSTPU_FUSED_ATTN", "1")
+    assert L.attention_plan(512, 12, 64, causal=True) == ("stream", "stream")
+    assert L.attention_plan(128, 12, 64, causal=False) == ("block", "block")
+    monkeypatch.setenv("DSTPU_FUSED_ATTN", "0")
+    assert L.attention_plan(2048, 12, 64, causal=True) == ("xla", "xla")
 
 
 def test_calibrate_requires_tpu(monkeypatch):

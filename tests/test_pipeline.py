@@ -249,19 +249,59 @@ def test_1f1b_rejects_unknown_schedule():
         run_engine(pipelined, make_mesh(pipeline_parallel_size=2), steps=1)
 
 
-def test_sharded_head_fallback_indivisible_batch():
+def test_sharded_head_fallback_indivisible_batch(caplog):
     """Per-shard batch 1 under pp=2 cannot split across stages; the head
     falls back to the replicated mask_to_last_stage path and the trajectory
-    still matches plain GPT-2."""
+    still matches plain GPT-2 — and the degraded path WARNS (one-time), so
+    users know they left the scatter-collect fast path."""
+    import logging
+
     kw = dict(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=4,
               hidden_size=32, num_heads=4)
     plain = GPT2.from_size("tiny", **kw)
     pipelined = GPT2Pipelined.from_size("tiny", num_micro_batches=1, **kw)
     ref, _ = run_engine(plain, make_mesh(devices=jax.devices()[:4]),
                         batch=4)
-    got, _ = run_engine(pipelined, make_mesh(pipeline_parallel_size=2),
-                        batch=4)
+    pipe_mod._warned_slow_paths.clear()
+    with caplog.at_level(logging.WARNING):
+        got, _ = run_engine(pipelined, make_mesh(pipeline_parallel_size=2),
+                            batch=4)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    assert any("full psum output collect" in r.message
+               for r in caplog.records), caplog.records
+
+
+def test_1f1b_replicated_head_fallback_warns(caplog):
+    """1F1B with mb % pp != 0 runs the full-head masked VJP on every stage;
+    the one-time warning must fire and the run stays finite."""
+    import logging
+
+    kw = dict(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=4,
+              hidden_size=32, num_heads=4)
+    pipelined = GPT2Pipelined.from_size("tiny", num_micro_batches=1,
+                                        schedule="1f1b", **kw)
+    pipe_mod._warned_slow_paths.clear()
+    with caplog.at_level(logging.WARNING):
+        losses, _ = run_engine(pipelined,
+                               make_mesh(pipeline_parallel_size=2),
+                               steps=2, batch=4)
+    assert all(np.isfinite(losses))
+    assert any("REPLICATED" in r.message for r in caplog.records), \
+        caplog.records
+    # one-time: a second trace does not re-warn
+    n = sum("REPLICATED" in r.message for r in caplog.records)
+    assert n == 1
+
+
+def test_warn_slow_path_once_is_one_time(caplog):
+    import logging
+
+    pipe_mod._warned_slow_paths.discard("unit_test_key")
+    with caplog.at_level(logging.WARNING):
+        pipe_mod.warn_slow_path_once("unit_test_key", "slow path taken")
+        pipe_mod.warn_slow_path_once("unit_test_key", "slow path taken")
+    assert sum("slow path taken" in r.message
+               for r in caplog.records) == 1
 
 
 def test_zero_and_checkpoint_compose_with_pipeline(tmpdir):
