@@ -1,0 +1,193 @@
+"""Graph Lint — jaxpr-level static analysis of the engine's step programs.
+
+JAX exposes the whole train step as a traceable jaxpr before any chip
+executes it, so the distributed-training mistakes that cost a multi-hour
+hang on a pod slice are decidable at engine-build time.  Four passes
+(``analysis/passes.py``):
+
+1. collective consistency (rank-divergent collective order = deadlock)
+2. precision flow (fp32 compute reachable from bf16/fp16 via upcasts)
+3. transfer/recompile lint (host callbacks, weak types, donation)
+4. shard-spec validation (specs vs mesh axes and value shapes, pre-compile)
+
+Three entry points:
+
+* engine config ``graph_lint: {"mode": "off"|"warn"|"error"}`` — the engine
+  lints each step program once per batch format at build time.
+* CLI ``python -m deepspeed_tpu.analysis <ds_config.json> ...`` — builds a
+  representative model for the config, traces, prints a findings report.
+* library: :func:`analyze_jaxpr` for any jaxpr, :func:`analyze_engine` for
+  a constructed engine + batch.
+
+See docs/analysis.md for the rule catalogue and suppression story.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+
+from deepspeed_tpu.analysis import graph  # noqa: F401  (re-export for users)
+from deepspeed_tpu.analysis import passes
+from deepspeed_tpu.analysis.report import (ERROR, INFO, WARNING, Finding,
+                                           GraphLintError, Report,
+                                           ShardSpecError)
+
+logger = logging.getLogger(__name__)
+
+MODES = ("off", "warn", "error")
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "Finding", "Report", "GraphLintError",
+    "ShardSpecError", "MODES", "analyze_jaxpr", "analyze_step",
+    "analyze_engine", "analyze_engine_train_batch", "check_shard_specs",
+    "validate_specs_or_raise", "dispatch_report",
+]
+
+
+def analyze_jaxpr(jaxpr, mesh_axes: Optional[Sequence[str]] = None,
+                  subject: str = "") -> Report:
+    """Run the three jaxpr passes over one (closed or open) jaxpr."""
+    rep = Report(subject=subject)
+    passes.check_collectives(jaxpr, rep, mesh_axes=mesh_axes)
+    passes.check_precision(jaxpr, rep)
+    passes.check_transfers(jaxpr, rep)
+    return rep
+
+
+def analyze_step(fn, args, mesh=None, subject: str = "") -> Report:
+    """Trace ``fn(*args)`` to a jaxpr (jitted fns included — the pjit level
+    is walked through, and its ``donated_invars`` feed the donation lint)
+    and run the jaxpr passes."""
+    mesh_axes = list(mesh.shape.keys()) if mesh is not None else None
+    closed = jax.make_jaxpr(fn)(*args)
+    return analyze_jaxpr(closed, mesh_axes=mesh_axes, subject=subject)
+
+
+def check_shard_specs(mesh, specs, tree, subject: str = "",
+                      where: str = "") -> Report:
+    """Pass 4 standalone: PartitionSpecs vs mesh axes and value shapes."""
+    rep = Report(subject=subject)
+    passes.check_shard_specs(dict(mesh.shape), specs, tree, rep, where=where)
+    return rep
+
+
+def validate_specs_or_raise(mesh, specs, tree, where: str = "") -> None:
+    """The engine's first-class pre-compile shard-spec gate: raises
+    :class:`ShardSpecError` naming the offending leaf, spec and axis
+    instead of letting shard_map fail with a raw spec-mismatch error.
+    Always on (independent of ``graph_lint.mode``) — it replaces a crash,
+    it does not add a new failure mode."""
+    rep = check_shard_specs(mesh, specs, tree, where=where)
+    errs = rep.errors
+    if errs:
+        raise ShardSpecError(
+            f"invalid sharding for {where or 'shard_map operands'} "
+            f"({len(errs)} problem(s)):\n"
+            + "\n".join("  - " + f.message for f in errs))
+
+
+def analyze_engine(engine, batch, train: bool = True,
+                   include_step: bool = True) -> Report:
+    """Full engine analysis for one batch format: shard-spec pass over the
+    param and batch specs, then the jaxpr passes over the traced
+    forward+backward (or eval) program and the boundary step program."""
+    batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
+    rep = Report(subject="engine")
+
+    # pass 4 first: a spec problem would make tracing fail anyway
+    passes.check_shard_specs(dict(engine.mesh.shape), engine._param_specs,
+                             engine.params, rep, where="params")
+    passes.check_shard_specs(dict(engine.mesh.shape),
+                             engine._batch_specs(batch), batch, rep,
+                             where="batch")
+    if rep.errors:
+        return rep
+
+    mesh_axes = list(engine.mesh.shape.keys())
+    if train:
+        fwdbwd = engine._ensure_fwdbwd(batch)
+        traced = jax.make_jaxpr(fwdbwd)(
+            engine.params, engine.loss_scale_state.cur_scale, batch)
+        rep.extend(analyze_jaxpr(traced, mesh_axes=mesh_axes,
+                                 subject="fwdbwd"))
+        if include_step:
+            # shape of the accumulated grads == shape of one micro-step's
+            # grads (fp32 stacks / ZeRO partitions)
+            _, grad_shapes = jax.eval_shape(
+                fwdbwd, engine.params, engine.loss_scale_state.cur_scale,
+                batch)
+            if engine._step_fn is None:
+                engine._step_fn = engine._build_step()
+            master = (engine.master_flat if engine.zero_flat
+                      else engine.master)
+            step_tr = jax.make_jaxpr(engine._step_fn)(
+                master, engine.opt_state, grad_shapes,
+                engine.loss_scale_state, engine._current_hypers(),
+                engine._zero_norm_w, engine._zero_gid_flat)
+            rep.extend(analyze_jaxpr(step_tr, mesh_axes=mesh_axes,
+                                     subject="step"))
+            # master-weight precision contract (precision.MASTER_DTYPE):
+            # the fp32 master is what makes bf16/fp16 training converge
+            from deepspeed_tpu import precision as prec
+            bad = [str(jax.tree_util.keystr(p))
+                   for p, l in jax.tree_util.tree_flatten_with_path(
+                       master)[0]
+                   if hasattr(l, "dtype") and l.dtype != prec.MASTER_DTYPE]
+            if bad:
+                rep.add(
+                    "precision.master-dtype", ERROR,
+                    f"master weights are expected in fp32 but "
+                    f"{bad[:3]}{'...' if len(bad) > 3 else ''} are not — "
+                    f"low-precision masters silently stall convergence",
+                    pass_name="precision")
+    else:
+        ev = engine._ensure_eval(batch)
+        traced = jax.make_jaxpr(ev)(engine.params, batch)
+        rep.extend(analyze_jaxpr(traced, mesh_axes=mesh_axes,
+                                 subject="eval"))
+    return rep
+
+
+def analyze_engine_train_batch(engine, batch) -> Report:
+    """Jaxpr passes over the fused train_batch program (scan over gas
+    micro-steps feeding the boundary update) — one trace covers the model,
+    the collectives AND the optimizer."""
+    batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
+    rep = Report(subject="train_batch")
+    passes.check_shard_specs(dict(engine.mesh.shape),
+                             engine._batch_specs(batch), batch, rep,
+                             where="batch")
+    if rep.errors:
+        return rep
+    master = engine.master_flat if engine.zero_flat else engine.master
+    traced = jax.make_jaxpr(engine._train_batch_fn)(
+        engine.params, master, engine.opt_state, engine.loss_scale_state,
+        engine._current_hypers(), engine._zero_norm_w,
+        engine._zero_gid_flat, batch)
+    rep.extend(analyze_jaxpr(traced,
+                             mesh_axes=list(engine.mesh.shape.keys()),
+                             subject="train_batch"))
+    return rep
+
+
+def dispatch_report(rep: Report, mode: str, where: str = "",
+                    log: Optional[logging.Logger] = None) -> Report:
+    """Apply a ``graph_lint.mode``: log warnings+errors in ``warn`` mode,
+    raise :class:`GraphLintError` on error findings in ``error`` mode."""
+    log = log or logger
+    if mode == "off" or not len(rep):
+        return rep
+    worst = rep.errors or rep.warnings
+    if worst or rep.infos:
+        body = (rep.format(min_severity=WARNING) if worst else
+                f"{len(rep.infos)} info-severity finding(s); "
+                f"engine.run_graph_lint(batch).format() shows them")
+        log.log(logging.WARNING if worst else logging.INFO,
+                "graph lint%s: %s\n%s",
+                f" [{where}]" if where else "", rep.summary(), body)
+    if mode == "error":
+        rep.raise_on_error(where=where)
+    return rep

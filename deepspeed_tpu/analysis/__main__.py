@@ -1,0 +1,253 @@
+"""``python -m deepspeed_tpu.analysis`` — graph-lint a DeepSpeed config.
+
+For each config file a representative model is built (inferred from the
+path: ``*bert*`` → tiny BertForPreTraining, ``*gpt2*`` → tiny GPT2,
+anything else → the examples/simple MLP), an engine is constructed on a
+virtual CPU mesh, the train step is traced, and the findings report is
+printed.  Static analysis only — no optimizer step runs, no TPU is needed.
+
+    python -m deepspeed_tpu.analysis examples/simple/ds_config.json
+    python -m deepspeed_tpu.analysis --mode error examples/*/ds_config*.json
+
+Exit status: 0 clean (or ``--mode warn``), 2 when error-severity findings
+survive suppression in ``--mode error``, 1 on usage/analysis failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ENV_MARK = "_DSTPU_ANALYSIS_ENV"
+
+
+def _reexec_with_analysis_env(argv):
+    """Re-exec once with a deterministic analysis environment: CPU backend
+    (static analysis needs no accelerator), no experimental TPU plugin
+    registration (its registration breaks later CPU-platform selection on
+    some images), and enough virtual CPU devices for the config's mesh.
+    Mirrors tests/conftest.py, which documents the same wrinkle."""
+    if os.environ.get(_ENV_MARK) == "1":
+        return
+    env = dict(os.environ)
+    env[_ENV_MARK] = "1"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env["JAX_PLATFORMS"] == "cpu":
+        # virtual device count: lcm of 8 (covers the shipped configs)
+        # and every config's mp*sp*pp product, so make_mesh divides
+        import math
+        need = 8
+        for a in argv:
+            if a.endswith(".json") and os.path.exists(a):
+                try:
+                    with open(a) as f:
+                        cfg = json.load(f)
+                    prod = (int(cfg.get("model_parallel_size", 1))
+                            * int(cfg.get("context_parallel_size", 1))
+                            * int(cfg.get("pipeline_parallel_size", 1)))
+                    need = need * prod // math.gcd(need, prod)
+                except Exception:
+                    pass
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
+    env.setdefault("JAX_ENABLE_X64", "0")
+    os.execve(sys.executable,
+              [sys.executable, "-m", "deepspeed_tpu.analysis"] + argv, env)
+
+
+def _infer_family(path: str, override: str) -> str:
+    if override != "auto":
+        return override
+    base = path.lower()
+    if "bert" in base:
+        return "bert"
+    if "gpt" in base:
+        return "gpt2"
+    return "mlp"
+
+
+def _load_example_mlp(config_path: str):
+    """Lint the program the example ACTUALLY runs: when a train_simple.py
+    sits next to the config, import its MLP instead of the built-in
+    fallback copy — so the CI gate cannot drift from the example."""
+    import importlib.util
+    cand = os.path.join(os.path.dirname(os.path.abspath(config_path)),
+                        "train_simple.py")
+    if not os.path.exists(cand):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_dstpu_lint_example", cand)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        cls = getattr(mod, "MLP", None)
+        hidden = int(getattr(mod, "HIDDEN", 64))
+        if cls is not None:
+            return cls(), hidden
+    except Exception as e:
+        print(f"note: could not import example model from {cand} ({e}); "
+              f"using the built-in MLP", file=sys.stderr)
+    return None
+
+
+def _build_model(family: str, seq_len: int, config_path: str = ""):
+    """A tiny engine-protocol model per family (the analysis runs over the
+    traced graph structure, so tiny shapes exercise the same program as
+    production sizes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if family == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2
+        model = GPT2.from_size("tiny")
+
+        def make_batch(b):
+            rng = np.random.default_rng(0)
+            toks = rng.integers(0, model.config.vocab_size,
+                                (b, seq_len)).astype(np.int32)
+            return (toks, toks.copy())
+        return model, make_batch
+
+    if family == "bert":
+        from deepspeed_tpu.models.bert import BertForPreTraining
+        model = BertForPreTraining.from_size("tiny")
+
+        def make_batch(b):
+            rng = np.random.default_rng(0)
+            ids = rng.integers(0, model.config.vocab_size,
+                               (b, seq_len)).astype(np.int32)
+            mask = np.ones((b, seq_len), np.int32)
+            tt = np.zeros((b, seq_len), np.int32)
+            labels = np.where(rng.random((b, seq_len)) < 0.15, ids, -1)
+            return (ids, mask, tt, labels.astype(np.int32))
+        return model, make_batch
+
+    loaded = _load_example_mlp(config_path)
+    if loaded is not None:
+        model, H = loaded
+    else:
+        H = 64
+
+        class MLP:
+            """Fallback copy of the examples/simple model (used only when
+            no train_simple.py sits next to the config): inputs cast to
+            the parameter dtype so fp16/bf16 configs run low-precision
+            matmuls."""
+
+            def init_params(self, rng):
+                k1, k2 = jax.random.split(rng)
+                s = 1.0 / np.sqrt(H)
+                return {"w1": jax.random.normal(k1, (H, H)) * s,
+                        "b1": jnp.zeros((H,)),
+                        "w2": jax.random.normal(k2, (H, 1)) * s}
+
+            def apply(self, params, x, y):
+                x = x.astype(params["w1"].dtype)
+                h = jax.nn.relu(x @ params["w1"] + params["b1"])
+                pred = (h @ params["w2"])[:, 0].astype(jnp.float32)
+                return jnp.mean((pred - y) ** 2)
+
+        model = MLP()
+
+    def make_batch(b):
+        rng = np.random.default_rng(0)
+        return (rng.normal(size=(b, H)).astype(np.float32),
+                rng.normal(size=(b,)).astype(np.float32))
+    return model, make_batch
+
+
+def _analyze_config(path: str, family: str, seq_len: int, suppress):
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu import analysis
+
+    with open(path) as f:
+        cfg = json.load(f)
+    # the CLI decides lint dispatch itself; the engine must not also raise
+    cfg.pop("graph_lint", None)
+    family = _infer_family(path, family)
+    model, make_batch = _build_model(family, seq_len, config_path=path)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    batch = make_batch(engine.train_micro_batch_size_per_gpu()
+                       * engine.dp_world_size)
+    rep = analysis.analyze_engine(engine, batch, train=True)
+    rep.subject = f"{path} (model={family})"
+    return rep.filtered(suppress)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _reexec_with_analysis_env(argv)
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis",
+        description="Statically analyze the train-step graph a DeepSpeed "
+                    "config would build (collectives, precision, "
+                    "transfers, shard specs).  See docs/analysis.md.")
+    ap.add_argument("configs", nargs="+",
+                    help="DeepSpeed JSON config file(s) to analyze")
+    ap.add_argument("--mode", choices=("warn", "error"), default="warn",
+                    help="'error': exit 2 on error-severity findings "
+                         "(the CI gate); 'warn' (default): report only")
+    ap.add_argument("--model", choices=("auto", "mlp", "gpt2", "bert"),
+                    default="auto",
+                    help="representative model family (default: inferred "
+                         "from the config path)")
+    ap.add_argument("--seq-len", type=int, default=64,
+                    help="sequence length for the synthetic batch")
+    ap.add_argument("--suppress", action="append", default=[],
+                    help="rule-code prefix to suppress (repeatable), e.g. "
+                         "--suppress precision.upcast")
+    ap.add_argument("--verbose", "-v", action="store_true",
+                    help="include info-severity findings in the report")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu import analysis
+
+    total_errors = 0
+    failed = []
+    for path in args.configs:
+        try:
+            rep = _analyze_config(path, args.model, args.seq_len,
+                                  args.suppress)
+        except Exception as e:
+            # keep analyzing the remaining configs so one broken config
+            # does not hide whether the others are clean
+            print(f"== {path}: ANALYSIS FAILED ==\n   {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            failed.append(path)
+            continue
+        print(f"== graph lint: {rep.subject} ==")
+        text = rep.format(min_severity=analysis.INFO if args.verbose
+                          else analysis.WARNING)
+        if text == "no findings" and rep.infos:
+            text = (f"no warning/error findings "
+                    f"({len(rep.infos)} info — use --verbose)")
+        print(text)
+        print(rep.summary())
+        print()
+        total_errors += len(rep.errors)
+
+    if failed:
+        print(f"graph lint: analysis failed for {len(failed)} config(s): "
+              f"{', '.join(failed)}", file=sys.stderr)
+        return 1
+    if args.mode == "error" and total_errors:
+        print(f"graph lint: {total_errors} error-severity finding(s) — "
+              f"failing (--mode error)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

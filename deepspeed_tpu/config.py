@@ -220,6 +220,31 @@ class DeepSpeedConfig:
         self.tensorboard_output_path = self.tensorboard.output_path
         self.tensorboard_job_name = self.tensorboard.job_name
 
+        # graph lint: jaxpr static analysis at step-build time
+        # (docs/analysis.md).  Accepts the {"mode": ..., "suppress": [...]}
+        # section or the bare-string shorthand "graph_lint": "error".
+        gl = pd.get(C.GRAPH_LINT, None)
+        if isinstance(gl, str):
+            gl = {C.GRAPH_LINT_MODE: gl}
+        if gl is not None and not isinstance(gl, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.GRAPH_LINT}' must be a mode string or an object "
+                f"{{'mode': ..., 'suppress': [...]}}, got {gl!r}")
+        self.graph_lint_mode = get_scalar_param(
+            gl, C.GRAPH_LINT_MODE, C.GRAPH_LINT_MODE_DEFAULT)
+        if self.graph_lint_mode not in ("off", "warn", "error"):
+            raise DeepSpeedConfigError(
+                f"{C.GRAPH_LINT}.{C.GRAPH_LINT_MODE} must be 'off', 'warn' "
+                f"or 'error', got {self.graph_lint_mode!r}")
+        sup = get_scalar_param(gl, C.GRAPH_LINT_SUPPRESS,
+                               C.GRAPH_LINT_SUPPRESS_DEFAULT)
+        if (not isinstance(sup, (list, tuple))
+                or not all(isinstance(s, str) for s in sup)):
+            raise DeepSpeedConfigError(
+                f"{C.GRAPH_LINT}.{C.GRAPH_LINT_SUPPRESS} must be a list of "
+                f"rule-code prefixes, got {sup!r}")
+        self.graph_lint_suppress = list(sup)
+
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)
         prof = pd.get(C.PROFILE, None) or {}

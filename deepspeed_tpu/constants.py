@@ -145,6 +145,18 @@ ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 ACTIVATION_CHECKPOINTING_DEFAULT = None
 
 #############################################
+# Graph lint (TPU-native: jaxpr static analysis of the step programs —
+# collective consistency, precision flow, transfer/recompile lint, shard
+# specs; docs/analysis.md).  No reference analog: torch graphs only exist
+# at runtime, jaxprs exist before any chip executes.
+#############################################
+GRAPH_LINT = "graph_lint"
+GRAPH_LINT_MODE = "mode"
+GRAPH_LINT_MODE_DEFAULT = "off"       # "off" | "warn" | "error"
+GRAPH_LINT_SUPPRESS = "suppress"      # list of rule-code prefixes
+GRAPH_LINT_SUPPRESS_DEFAULT = ()
+
+#############################################
 # Profiler (TPU-native: jax.profiler trace over a step window — the
 # tracing analog of wall_clock_breakdown, SURVEY §5 row 1)
 #############################################

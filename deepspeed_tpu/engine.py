@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu import analysis as graph_lint
 from deepspeed_tpu import constants as C
 from deepspeed_tpu import lr_schedules as schedules_mod
 from deepspeed_tpu import precision as prec
@@ -726,6 +727,14 @@ class DeepSpeedTpuEngine:
         self._hyper_key = None      # host values behind the staged hypers
         self._hyper_dev = None      # cached [4, G] device array
 
+        # -- graph lint (docs/analysis.md): jaxpr static analysis at
+        #    step-build time.  Each (program kind, batch format) pair is
+        #    analyzed once; "error" mode turns error-severity findings
+        #    into a build-time GraphLintError instead of a pod-slice hang.
+        self._graph_lint_mode = self.config.graph_lint_mode
+        self._graph_lint_suppress = list(self.config.graph_lint_suppress)
+        self._linted_keys = set()
+
         if self.config.dump_state:
             self.dump_state()
 
@@ -829,8 +838,9 @@ class DeepSpeedTpuEngine:
     def _init_parameters(self, model_parameters):
         """Place fp32 masters + compute-dtype params on the mesh (the
         reference's device placement + param broadcast, deepspeed_light.py:
-        415-430, and the fp32 master clone, zero_optimizer.py:158-165)."""
-        to_f32 = lambda x: jnp.asarray(x, jnp.float32)
+        415-430, and the fp32 master clone, zero_optimizer.py:158-165).
+        Master dtype contract: prec.MASTER_DTYPE (graph-lint-enforced)."""
+        to_f32 = lambda x: jnp.asarray(x, prec.MASTER_DTYPE)
         masters = jax.tree_util.tree_map(to_f32, model_parameters)
 
         if self.zero_flat and self._zero_state_axes:
@@ -1364,6 +1374,70 @@ class DeepSpeedTpuEngine:
             cache[key] = fn
         return fn
 
+    def _checked_batch_specs(self, batch):
+        """Batch specs validated against the mesh and the actual leaf
+        shapes BEFORE shard_map construction: a mismatch (unknown axis,
+        non-divisible batch/sequence dim) raises a ShardSpecError naming
+        the offending leaf, spec and axis instead of surfacing later as a
+        raw shard_map spec-mismatch crash (the PR-1 failure class)."""
+        specs = self._batch_specs(batch)
+        graph_lint.validate_specs_or_raise(self.mesh, specs, batch,
+                                           where="batch")
+        return specs
+
+    def _maybe_graph_lint(self, kind, key, run):
+        """Run one lint analysis (once per (program kind, batch format))
+        and dispatch it per ``graph_lint.mode``.  Analysis failures warn
+        and move on — lint must never take down a healthy build; findings
+        in 'error' mode raise GraphLintError."""
+        mode = self._graph_lint_mode
+        if mode == "off" or (kind, key) in self._linted_keys:
+            return
+        self._linted_keys.add((kind, key))
+        try:
+            rep = run()
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning("graph lint could not analyze %s: %s", kind, e)
+            return
+        rep = rep.filtered(self._graph_lint_suppress)
+        try:
+            graph_lint.dispatch_report(rep, mode, where=kind, log=logger)
+        except graph_lint.GraphLintError:
+            # stay sticky: a retried build of the same format must lint
+            # (and fail) again, not silently proceed to train
+            self._linted_keys.discard((kind, key))
+            raise
+
+    def run_graph_lint(self, batch, train: bool = True):
+        """Analyze the step programs for ``batch``'s format and return the
+        :class:`deepspeed_tpu.analysis.Report` (the CLI and test surface;
+        ignores ``graph_lint.mode``)."""
+        batch = _as_tuple(batch)
+        rep = graph_lint.analyze_engine(self, batch, train=train)
+        return rep.filtered(self._graph_lint_suppress)
+
+    def _ensure_fwdbwd(self, batch, key=None):
+        """Build-or-fetch the fused fwd+bwd program for this batch format
+        (shared by forward() and the graph-lint tracer)."""
+        if key is None:
+            key = self._batch_cache_key(batch)
+        if self._fwdbwd_fn is None or self._fwdbwd_key != key:
+            self._fwdbwd_fn = self._cached_batch_fn(
+                self._fwdbwd_fns, key,
+                lambda: self._build_fwdbwd(batch))
+            self._fwdbwd_key = key
+            self._loss_treedef = self._loss_treedefs.get(key)
+        return self._fwdbwd_fn
+
+    def _ensure_eval(self, batch, key=None):
+        if key is None:
+            key = self._batch_cache_key(batch)
+        if self._eval_fn is None or self._eval_key != key:
+            self._eval_fn = self._cached_batch_fn(
+                self._eval_fns, key, lambda: self._build_eval(batch))
+            self._eval_key = key
+        return self._eval_fn
+
     def _build_fwdbwd(self, batch):
         loss_and_grads = self._make_loss_and_grads()
         stage2 = self.zero_stage == 2
@@ -1381,7 +1455,7 @@ class DeepSpeedTpuEngine:
 
         fn = jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(self._param_specs, P(), self._batch_specs(batch)),
+            in_specs=(self._param_specs, P(), self._checked_batch_specs(batch)),
             out_specs=(P(), self._zero_flat_spec() if stage2
                        else self._z3_grad_specs() if zero3
                        else self._grad_stack_specs()),
@@ -1399,7 +1473,7 @@ class DeepSpeedTpuEngine:
 
         fn = jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(self._param_specs, self._batch_specs(batch)),
+            in_specs=(self._param_specs, self._checked_batch_specs(batch)),
             out_specs=P(),
             check_vma=False)
         return jax.jit(fn)
@@ -1432,12 +1506,10 @@ class DeepSpeedTpuEngine:
             # train pending in place — backward() may still consume it)
             self._pending = None
             key = self._batch_cache_key(batch)
-            if self._fwdbwd_fn is None or self._fwdbwd_key != key:
-                self._fwdbwd_fn = self._cached_batch_fn(
-                    self._fwdbwd_fns, key,
-                    lambda: self._build_fwdbwd(batch))
-                self._fwdbwd_key = key
-                self._loss_treedef = self._loss_treedefs.get(key)
+            self._ensure_fwdbwd(batch, key=key)
+            self._maybe_graph_lint(
+                "train", key,
+                lambda: graph_lint.analyze_engine(self, batch, train=True))
             if self._loss_treedef is None:
                 loss_shape, _ = jax.eval_shape(
                     self._fwdbwd_fn, self.params,
@@ -1462,10 +1534,10 @@ class DeepSpeedTpuEngine:
             # report window (timer.py window accounting)
             self.tput_timer.discard_window()
             key = self._batch_cache_key(batch)
-            if self._eval_fn is None or self._eval_key != key:
-                self._eval_fn = self._cached_batch_fn(
-                    self._eval_fns, key, lambda: self._build_eval(batch))
-                self._eval_key = key
+            self._ensure_eval(batch, key=key)
+            self._maybe_graph_lint(
+                "eval", key,
+                lambda: graph_lint.analyze_engine(self, batch, train=False))
             loss = self._eval_fn(self.params, batch)
             self._last_loss = loss
             if wcb:
@@ -2119,7 +2191,7 @@ class DeepSpeedTpuEngine:
             local, mesh=self.mesh,
             in_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                       P(), P(DATA_AXIS), P(DATA_AXIS),
-                      self._batch_specs(batch)),
+                      self._checked_batch_specs(batch)),
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P(), P()),
             check_vma=False)
@@ -2162,6 +2234,9 @@ class DeepSpeedTpuEngine:
                 self._train_batch_fns, key,
                 lambda: self._build_train_batch(batch))
             self._train_batch_key = key
+        self._maybe_graph_lint(
+            "train_batch", key,
+            lambda: graph_lint.analyze_engine_train_batch(self, batch))
         master = self.master_flat if self.zero_flat else self.master
         (self.params, new_master, self.opt_state, self.loss_scale_state,
          overflow, self._last_grad_norm, loss) = self._train_batch_fn(

@@ -647,13 +647,45 @@ def _check_impls(fwd_impl: str, bwd_impl: str) -> None:
             "'xla'")
 
 
+@jax.custom_vjp
+def _qk_scores(q, k):
+    """q@k^T scores with fp32 MXU accumulation on low-precision operands.
+
+    The custom backward rounds the fp32 score cotangent to the compute
+    dtype BEFORE the dq/dk transpose matmuls (fp32 accumulation kept via
+    ``preferred_element_type``) — the same convention every Pallas kernel
+    here uses (``ds.astype(cdt)``).  Plain autodiff would feed the fp32
+    cotangent straight into the transpose dots, silently running the
+    attention backward at fp32 MXU rates on the bf16/fp16 training path
+    (graph-lint ``precision.upcast-dot``).  In fp32 the casts are
+    identities and the math is unchanged."""
+    return jnp.einsum("btnd,bsnd->bnts", q, k,
+                      preferred_element_type=jnp.float32)
+
+
+def _qk_scores_fwd(q, k):
+    return _qk_scores(q, k), (q, k)
+
+
+def _qk_scores_bwd(res, g):
+    q, k = res
+    gl = g.astype(q.dtype)
+    dq = jnp.einsum("bnts,bsnd->btnd", gl, k,
+                    preferred_element_type=jnp.float32).astype(q.dtype)
+    dk = jnp.einsum("bnts,btnd->bsnd", gl, q,
+                    preferred_element_type=jnp.float32).astype(k.dtype)
+    return dq, dk
+
+
+_qk_scores.defvjp(_qk_scores_fwd, _qk_scores_bwd)
+
+
 def xla_attention(q, k, v, attn_mask, causal, with_lse=False):
     """Plain-XLA attention (the models/layers.py einsum path), optionally
     emitting the logsumexp in the streaming kernels' [G, 1, T] layout so a
     streaming backward can follow an XLA forward."""
     B, T, n, d = q.shape
-    scores = jnp.einsum("btnd,bsnd->bnts", q, k,
-                        preferred_element_type=jnp.float32)
+    scores = _qk_scores(q, k)
     scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
     if causal:
         cmask = jnp.tril(jnp.ones((T, T), jnp.bool_))

@@ -42,6 +42,13 @@ MIN_LOSS_SCALE = "min_scale"
 INLINE = "inline"          # fp16_optimizer.py:245-272
 MEGATRON = "megatron"      # loss_scaler.py:143-167 (DynamicLossScaler)
 
+#: The master-weight dtype contract: bf16/fp16 training converges because
+#: the optimizer update accumulates into fp32 masters (reference fp32
+#: clone, fp16_optimizer.py:158-165).  Single source of truth — the
+#: engine places masters in this dtype and the graph-lint
+#: ``precision.master-dtype`` rule (analysis/__init__.py) enforces it.
+MASTER_DTYPE = jnp.float32
+
 
 class LossScaleState(NamedTuple):
     """Scalar-leaf pytree; lives on device inside the train step."""

@@ -41,8 +41,13 @@ class MLP:
         }
 
     def apply(self, params, x, y):
+        # cast inputs to the parameter dtype: under fp16/bf16 the engine
+        # keeps params low-precision, and an fp32 batch would silently
+        # promote every matmul back to fp32 (graph-lint
+        # precision.upcast-dot); the loss math stays fp32
+        x = x.astype(params["w1"].dtype)
         h = jax.nn.relu(x @ params["w1"] + params["b1"])
-        pred = (h @ params["w2"])[:, 0]
+        pred = (h @ params["w2"])[:, 0].astype(jnp.float32)
         return jnp.mean((pred - y) ** 2)
 
 
