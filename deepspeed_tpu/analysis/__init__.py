@@ -42,7 +42,8 @@ MODES = ("off", "warn", "error")
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Report", "GraphLintError",
     "ShardSpecError", "MODES", "analyze_jaxpr", "analyze_step",
-    "analyze_engine", "analyze_engine_train_batch", "check_shard_specs",
+    "analyze_engine", "analyze_engine_train_batch", "trace_train_batch",
+    "check_shard_specs",
     "validate_specs_or_raise", "dispatch_report",
 ]
 
@@ -151,6 +152,20 @@ def analyze_engine(engine, batch, train: bool = True,
     return rep
 
 
+def trace_train_batch(engine, batch, fn=None):
+    """Jaxpr of the fused train_batch program with the engine's CURRENT
+    state as example args — the single owner of the step-function call
+    protocol (callers must not hand-marshal the 8-tuple; the overlap
+    microbench counts collectives through this too).  ``fn`` defaults to
+    the engine's built ``_train_batch_fn``."""
+    batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
+    master = engine.master_flat if engine.zero_flat else engine.master
+    return jax.make_jaxpr(fn or engine._train_batch_fn)(
+        engine.params, master, engine.opt_state, engine.loss_scale_state,
+        engine._current_hypers(), engine._zero_norm_w,
+        engine._zero_gid_flat, batch)
+
+
 def analyze_engine_train_batch(engine, batch) -> Report:
     """Jaxpr passes over the fused train_batch program (scan over gas
     micro-steps feeding the boundary update) — one trace covers the model,
@@ -162,12 +177,7 @@ def analyze_engine_train_batch(engine, batch) -> Report:
                              where="batch")
     if rep.errors:
         return rep
-    master = engine.master_flat if engine.zero_flat else engine.master
-    traced = jax.make_jaxpr(engine._train_batch_fn)(
-        engine.params, master, engine.opt_state, engine.loss_scale_state,
-        engine._current_hypers(), engine._zero_norm_w,
-        engine._zero_gid_flat, batch)
-    rep.extend(analyze_jaxpr(traced,
+    rep.extend(analyze_jaxpr(trace_train_batch(engine, batch),
                              mesh_axes=list(engine.mesh.shape.keys()),
                              subject="train_batch"))
     return rep

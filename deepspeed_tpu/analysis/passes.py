@@ -10,7 +10,12 @@ the rule catalogue; rule codes are stable and suppressible by prefix.
    rank-dependent value, so the pass taints dataflow from ``axis_index`` and
    compares the ordered collective signatures of every ``cond``/``switch``
    branch whose predicate carries that taint (the 1F1B/GPipe stage
-   schedules in parallel/pipeline.py are exactly this shape).  Also checks
+   schedules in parallel/pipeline.py are exactly this shape).  Signatures
+   include the operand shape/dtype — the wire format — so the
+   ``overlap_comm`` bucketed boundary (K same-primitive collectives told
+   apart only by their bucket shapes) and the ZeRO-3 prefetched gather
+   sequence compare exactly: branches bucketing the same payload
+   differently are a real deadlock and are flagged.  Also checks
    axis names against the engine mesh and ``ppermute`` permutation validity
    — all of ``comm.py``'s wrappers (psum, psum_scatter with
    ``axis_index_groups`` sub-groups, all_gather) produce these primitives.
@@ -96,12 +101,25 @@ def _collective_sig(eqn) -> Tuple:
     groups = p.get("axis_index_groups")
     perm = p.get("perm")
     layout = tuple((k, p[k]) for k in _SIG_LAYOUT_KEYS if k in p)
+    # operand shapes/dtypes are part of the wire format: under overlap_comm
+    # the boundary issues K same-primitive bucketed collectives whose only
+    # distinguishing feature is the buffer shape, so two branches bucketing
+    # the same payload DIFFERENTLY (or one bucketed, one monolithic) must
+    # compare unequal — ranks in either branch would block exchanging
+    # mismatched buffers.  ALL operands are hashed: psum-family eqns carry
+    # several arrays at once, and a divergence in operand 2..N (or in the
+    # operand count) mismatches on the wire just as hard as the first
+    op = tuple(
+        (tuple(getattr(v.aval, "shape", ())),
+         str(getattr(v.aval, "dtype", "")))
+        for v in eqn.invars)
     return (
         eqn.primitive.name,
         tuple(str(a) for a in axes),
         None if groups is None else tuple(tuple(g) for g in groups),
         None if perm is None else tuple(tuple(pr) for pr in perm),
         layout,
+        op,
     )
 
 
@@ -110,7 +128,7 @@ def _fmt_sig(sig: Tuple) -> str:
         _, length, inner = sig
         body = ", ".join(_fmt_sig(s) for s in inner)
         return f"scan[length={length}]({body})"
-    name, axes, groups, perm, layout = sig
+    name, axes, groups, perm, layout, op = sig
     s = f"{name}(axis={','.join(axes)}"
     if groups is not None:
         s += f", groups={list(map(list, groups))}"
@@ -118,6 +136,8 @@ def _fmt_sig(sig: Tuple) -> str:
         s += f", perm={list(map(list, perm))}"
     for k, v in layout:
         s += f", {k}={v}"
+    for shape, dt in op:
+        s += f", operand={dt}{list(shape)}"
     return s + ")"
 
 

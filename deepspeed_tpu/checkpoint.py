@@ -69,7 +69,13 @@ def _to_np(tree):
 
 _MAGIC = b"DSTPUCK1"
 _CHUNK_TAG = "__dstpu_chunk__"
+#: wrapper for USER tuples that collide with the ref namespace (a tuple in
+#: ``client_state`` whose first element is the chunk/escape tag string):
+#: the writer wraps them ``(_ESCAPE_TAG, t)`` at seal time, the reader
+#: unwraps — so a chunk ref is ALWAYS the writer's own, never user data
+_ESCAPE_TAG = "__dstpu_escape__"
 _INLINE_MAX = 512          # small arrays stay pickled in the header
+_HEADER_PREFIX = len(_MAGIC) + 8   # magic + header-offset word
 _ML_DTYPES = {"bfloat16", "float8_e3m4", "float8_e4m3",
               "float8_e4m3b11fnuz", "float8_e4m3fn", "float8_e4m3fnuz",
               "float8_e5m2", "float8_e5m2fnuz", "float8_e8m0fnu",
@@ -96,16 +102,30 @@ class _ChunkedWriter:
         self._f = open(self._tmp, "wb")
         self._f.write(_MAGIC)
         self._f.write((0).to_bytes(8, "little"))
+        self._refs = set()     # id()s of the ref tuples THIS writer issued
 
     def put_array(self, arr) -> tuple:
         a = np.ascontiguousarray(np.asarray(arr))
         off = self._f.tell()
         a.tofile(self._f)
-        return (_CHUNK_TAG, off, a.dtype.name, tuple(a.shape))
+        ref = (_CHUNK_TAG, off, a.dtype.name, tuple(a.shape))
+        self._refs.add(id(ref))
+        return ref
 
     def put(self, obj):
         if isinstance(obj, dict):
             return {k: self.put(v) for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            # the restricted unpickler cannot reconstruct user namedtuple
+            # classes on load, and silently flattening them to plain
+            # tuples (what this writer once did) corrupts round trips —
+            # refuse loudly (docs/features.md "client_state restrictions")
+            raise TypeError(
+                f"checkpoint state contains a namedtuple "
+                f"({type(obj).__name__}): convert it to a dict or plain "
+                f"tuple before save_checkpoint — namedtuple classes "
+                f"cannot be reconstructed by the restricted checkpoint "
+                f"loader")
         if isinstance(obj, (list, tuple)):
             t = [self.put(v) for v in obj]
             return t if isinstance(obj, list) else tuple(t)
@@ -114,7 +134,26 @@ class _ChunkedWriter:
             return self.put_array(obj)
         return obj
 
+    def _escape(self, obj):
+        """Namespace the ref tags: any tuple in the header that LOOKS like
+        a chunk ref / escape wrapper but was not issued by this writer is
+        user data — wrap it ``(_ESCAPE_TAG, t)`` so the reader never
+        misinterprets it (``_resolve_chunks`` unwraps)."""
+        if id(obj) in self._refs:
+            return obj
+        if isinstance(obj, dict):
+            return {k: self._escape(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [self._escape(v) for v in obj]
+        if isinstance(obj, tuple):
+            t = tuple(self._escape(v) for v in obj)
+            if t and t[0] in (_CHUNK_TAG, _ESCAPE_TAG):
+                return (_ESCAPE_TAG, t)
+            return t
+        return obj
+
     def finish(self, header: Any) -> None:
+        header = self._escape(header)
         off = self._f.tell()
         pickle.dump(header, self._f, protocol=pickle.HIGHEST_PROTOCOL)
         self._f.seek(len(_MAGIC))
@@ -128,18 +167,47 @@ class _ChunkedWriter:
             os.remove(self._tmp)
 
 
-def _resolve_chunks(obj, path: str):
-    """Replace chunk refs with read-only np.memmap views into ``path``."""
+def _resolve_chunks(obj, path: str, payload_end: Optional[int] = None):
+    """Replace chunk refs with read-only np.memmap views into ``path``.
+
+    ``payload_end`` is the header offset — the payload region is
+    ``[_HEADER_PREFIX, payload_end)`` and every ref is validated against
+    it (offset/dtype/shape) BEFORE the memmap is constructed: a corrupt or
+    truncated ref raises a ValueError naming the problem instead of
+    handing back a garbage view.  User tuples that collide with the tag
+    namespace arrive wrapped ``(_ESCAPE_TAG, t)`` and unwrap here."""
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == _ESCAPE_TAG:
+        return tuple(_resolve_chunks(v, path, payload_end) for v in obj[1])
     if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _CHUNK_TAG:
         _, off, dtype_name, shape = obj
-        return np.memmap(path, dtype=_np_dtype(dtype_name), mode="r",
+        if not (isinstance(off, int) and isinstance(dtype_name, str)
+                and isinstance(shape, (tuple, list))
+                and all(isinstance(s, int) and s >= 0 for s in shape)):
+            raise ValueError(
+                f"corrupt checkpoint {path!r}: malformed chunk ref "
+                f"{obj!r}")
+        try:
+            dtype = _np_dtype(dtype_name)
+        except Exception:
+            raise ValueError(
+                f"corrupt checkpoint {path!r}: chunk ref names unknown "
+                f"dtype {dtype_name!r}")
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if off < _HEADER_PREFIX or (
+                payload_end is not None and off + nbytes > payload_end):
+            raise ValueError(
+                f"corrupt checkpoint {path!r}: chunk ref offset={off} "
+                f"size={nbytes} falls outside the payload region "
+                f"[{_HEADER_PREFIX}, {payload_end})")
+        return np.memmap(path, dtype=dtype, mode="r",
                          offset=off, shape=tuple(shape))
     if isinstance(obj, dict):
-        return {k: _resolve_chunks(v, path) for k, v in obj.items()}
+        return {k: _resolve_chunks(v, path, payload_end)
+                for k, v in obj.items()}
     if isinstance(obj, list):
-        return [_resolve_chunks(v, path) for v in obj]
+        return [_resolve_chunks(v, path, payload_end) for v in obj]
     if isinstance(obj, tuple):
-        return tuple(_resolve_chunks(v, path) for v in obj)
+        return tuple(_resolve_chunks(v, path, payload_end) for v in obj)
     return obj
 
 
@@ -208,7 +276,7 @@ def _load_obj(path: str) -> Any:
             off = int.from_bytes(f.read(8), "little")
             f.seek(off)
             header = _RestrictedUnpickler(f).load()
-            return _resolve_chunks(header, path)
+            return _resolve_chunks(header, path, payload_end=off)
         f.seek(0)            # legacy single-pickle file (round <= 4)
         return _RestrictedUnpickler(f).load()
 
@@ -528,6 +596,24 @@ class _AsyncSaver:
 ASYNC_SAVER = _AsyncSaver()
 
 
+def _reject_namedtuples(obj, where: str) -> None:
+    """Raise on namedtuples anywhere in a user state tree (see
+    _ChunkedWriter.put; checked eagerly so async saves fail at submit
+    time on the calling thread, not inside the background writer)."""
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        raise TypeError(
+            f"save_checkpoint: {where} contains a namedtuple "
+            f"({type(obj).__name__}): convert it to a dict or plain tuple "
+            f"— namedtuple classes cannot be reconstructed by the "
+            f"restricted checkpoint loader (docs/features.md)")
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _reject_namedtuples(v, f"{where}[{k!r}]")
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _reject_namedtuples(v, f"{where}[{i}]")
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
                     async_save: Optional[bool] = None) -> str:
@@ -551,6 +637,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "and cannot run on the writer thread)")
         async_save = False
     ASYNC_SAVER.wait()     # serialize with any still-pending earlier save
+    # client_state restriction (docs/features.md): namedtuples cannot be
+    # reconstructed by the restricted loader, and the async writer once
+    # silently flattened them to plain tuples — reject at CALL time so the
+    # failure is synchronous in both save modes
+    _reject_namedtuples(client_state, "client_state")
 
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.join(save_dir, tag)
@@ -581,6 +672,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "pp_world_size": pp,
         "client_state": dict(client_state or {}),
     }
+    # the scheduler's state_dict is user-shaped too: catch namedtuples
+    # there at CALL time as well, or an async save would only fail later
+    # on the writer thread (surfacing at the NEXT wait/save, far from the
+    # offending call)
+    _reject_namedtuples(scalar_state["lr_scheduler"],
+                        "lr_scheduler.state_dict()")
 
     S = pp * mp
     specs = engine._param_specs
@@ -759,11 +856,17 @@ def _zero3_shard_writes(engine, save_dir, tag, axes):
             ml = treedef.flatten_up_to(mast3[r])
             mm = None if m3 is None else treedef.flatten_up_to(m3[r])
             vv = None if v3 is None else treedef.flatten_up_to(v3[r])
+            # records key by FLATTEN-ORDER LEAF INDEX: the index is the
+            # one identifier save and load share exactly (both walk the
+            # same treedef), whereas a formatted keystr depends on the key
+            # type's repr — an int-keyed dict in the state tree broke the
+            # old string reconstruction.  keystr stays as a debug label.
             recs = {}
             for i, key in enumerate(keys):
                 if skip[i] is not None:
                     continue
-                recs[key] = {
+                recs[i] = {
+                    "keystr": key,
                     "dim": int(dflat[i]),
                     "param": put(pl[i]),
                     "master": put(ml[i]),
@@ -894,31 +997,43 @@ def _zero3_rehydrate(load_dir: str, tag: str, states):
                 cache[dpi] = _load_obj(f)["leaves"]
             return cache[dpi]
 
-        def fix(obj, path, field):
-            if _z3_marker(obj):
-                _, dim, dp = obj
-                return np.concatenate(
-                    [np.asarray(shard_leaves(d)[path][field])
-                     for d in range(dp)], axis=dim)
-            if isinstance(obj, dict):
-                return {k: fix(v, f"{path}['{k}']", field)
-                        for k, v in obj.items()}
-            if isinstance(obj, list):
-                return [fix(v, f"{path}[{i}]", field)
-                        for i, v in enumerate(obj)]
-            if isinstance(obj, tuple):
-                return tuple(fix(v, f"{path}[{i}]", field)
-                             for i, v in enumerate(obj))
-            return obj
+        def fix(tree, field):
+            """Replace markers by walking the state tree in FLATTEN ORDER:
+            leaf i here is leaf i of the saving engine's params tree, so
+            the shard record is ``leaves[i]`` — no path-string formatting
+            (the old hand-built keystrs broke on int-keyed dicts; ADVICE
+            r5).  ``keystr``-keyed records from legacy shard files still
+            resolve as a fallback."""
+            idx = [-1]
 
-        state["module"] = fix(state["module"], "", "param")
+            def one(path, leaf):
+                idx[0] += 1
+                if not _z3_marker(leaf):
+                    return leaf
+                _, dim, dp = leaf
+
+                def rec(d):
+                    leaves = shard_leaves(d)
+                    r = leaves.get(idx[0])
+                    if r is None:   # legacy keystr-keyed shard files
+                        r = leaves[jax.tree_util.keystr(path)]
+                    return r
+
+                return np.concatenate(
+                    [np.asarray(rec(d)[field]) for d in range(dp)],
+                    axis=dim)
+
+            return jax.tree_util.tree_map_with_path(
+                one, tree, is_leaf=_z3_marker)
+
+        state["module"] = fix(state["module"], "param")
         opt = state.get("optimizer")
         if opt is not None:
-            opt["master"] = fix(opt["master"], "", "master")
+            opt["master"] = fix(opt["master"], "master")
             if opt["opt_state"]["m"] is not None:
-                opt["opt_state"]["m"] = fix(opt["opt_state"]["m"], "", "m")
+                opt["opt_state"]["m"] = fix(opt["opt_state"]["m"], "m")
             if opt["opt_state"]["v"] is not None:
-                opt["opt_state"]["v"] = fix(opt["opt_state"]["v"], "", "v")
+                opt["opt_state"]["v"] = fix(opt["opt_state"]["v"], "v")
     return states
 
 

@@ -208,3 +208,14 @@ SEQUENCE_PARALLEL_IMPL_DEFAULT = None     # None | "ring" | "ulysses"
 
 ZERO_PARAMETER_PARALLEL_SIZE = "parameter_parallel_size"
 ZERO_PARAMETER_PARALLEL_SIZE_DEFAULT = None
+
+# Comm/compute overlap: the boundary collectives (reduce-scatter / weight
+# all-gather, and the plain-DP grad psum) split into lane-aligned buckets so
+# XLA's async collectives can overlap each other and the shard-local update
+# (docs/scaling.md "Communication/compute overlap").  Bucketing only re-tiles
+# the same elementwise math, so it is bit-exact with the serial path;
+# DSTPU_OVERLAP=off restores the monolithic programs.
+ZERO_OVERLAP_COMM = "overlap_comm"
+ZERO_OVERLAP_COMM_DEFAULT = True
+ZERO_COMM_BUCKET_MB = "comm_bucket_mb"
+ZERO_COMM_BUCKET_MB_DEFAULT = 32.0

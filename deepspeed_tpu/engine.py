@@ -548,6 +548,29 @@ class DeepSpeedTpuEngine:
         # layout; ``zero_flat`` gates every flat-layout code path.
         self.zero3 = self.zero_stage == 3
         self.zero_flat = self.zero_enabled and not self.zero3
+        # -- comm/compute overlap (zero_optimization.overlap_comm): the
+        # boundary collectives split into lane-aligned buckets so XLA's
+        # async collectives overlap the shard-local update (and, at ZeRO-3,
+        # the block scan prefetches the next layer's gather).  Bucketing
+        # only re-tiles the same elementwise math — bit-exact with serial.
+        # DSTPU_OVERLAP=off is the escape hatch restoring today's exact
+        # monolithic programs (DSTPU_OVERLAP=on forces it over the config).
+        self.overlap_comm = bool(self.config.zero_overlap_comm)
+        _ov = os.environ.get("DSTPU_OVERLAP", "").strip().lower()
+        if _ov in ("off", "0", "false"):
+            self.overlap_comm = False
+        elif _ov in ("on", "1", "true"):
+            self.overlap_comm = True
+        elif _ov:
+            raise DeepSpeedConfigError(
+                f"DSTPU_OVERLAP={_ov!r} is not a valid mode: use 'on' or "
+                f"'off'")
+        # bucket size in fp32 elements, floored to the 128-lane tile (the
+        # flat partition is 128-padded, so aligned buckets never split a
+        # lane); comm_bucket_mb may be fractional for tiny test meshes
+        self.comm_bucket_elems = max(
+            128, (int(self.config.zero_comm_bucket_mb * (1 << 20)) // 4
+                  // 128) * 128)
         if self.zero3:
             if not hasattr(model, "zero3_dims"):
                 raise DeepSpeedConfigError(
@@ -648,6 +671,13 @@ class DeepSpeedTpuEngine:
             else:
                 model = self.module
             model.zero3_dims = self._zero3_dims
+            # overlap_comm at stage 3: the block scan runs over layer
+            # pairs and issues both gathers up front, so the second
+            # layer's all-gather hides under the first layer's compute
+            # (forward AND the remat-replayed backward) — transient
+            # weight memory is two gathered layers instead of one
+            # (transformer.scan_layers; docs/scaling.md)
+            model.zero3_prefetch = self.overlap_comm
         if param_groups is None and self.client_optimizer is None:
             # pure-JSON spelling (optimizer.param_groups); the explicit
             # initialize(param_groups=...) argument beats it, and a
@@ -1337,16 +1367,30 @@ class DeepSpeedTpuEngine:
         (default: when MP/PP state axes exist)."""
         cfg = self.config
         flat = zero_mod.flatten_tree(grads, self.flat_meta)
-        gpart = comm.reduce_scatter_grads(
-            flat, DATA_AXIS, self.dp_world_size,
+        knobs = dict(
             fp32_allreduce=cfg.fp32_allreduce,
             prescale_gradients=cfg.prescale_gradients,
             gradient_predivide_factor=cfg.gradient_predivide_factor,
             partition_group_size=self.zero_pps,
             across_subgroups=across_subgroups)
+        bounds = self._comm_buckets()
+        if bounds is not None:
+            gpart = comm.reduce_scatter_grads_bucketed(
+                flat, DATA_AXIS, self.dp_world_size, bounds, **knobs)
+        else:
+            gpart = comm.reduce_scatter_grads(
+                flat, DATA_AXIS, self.dp_world_size, **knobs)
         if rows is None:
             rows = bool(self._zero_state_axes)
         return gpart[None] if rows else gpart
+
+    def _comm_buckets(self):
+        """Bucket bounds over the owned flat partition under overlap_comm
+        (None = the serial monolithic path, DSTPU_OVERLAP=off)."""
+        if not self.overlap_comm or self.flat_meta is None:
+            return None
+        return comm.bucket_bounds(self.flat_meta.partition,
+                                  self.comm_bucket_elems)
 
     #: built batch-format executables kept per engine (a training run
     #: alternating two MLM formats needs exactly two)
@@ -1638,6 +1682,9 @@ class DeepSpeedTpuEngine:
         sparse_flags = self._sparse_flags
         group_ids = self._group_ids
         multi_group = len(self._group_defs) > 1
+        bounds = self._comm_buckets()      # None = serial boundary
+        bucket_elems = (self.comm_bucket_elems if self.overlap_comm
+                        else None)
 
         def step_local(master, opt_state, grads, ls_state, hypers,
                        normw, gids):
@@ -1716,23 +1763,72 @@ class DeepSpeedTpuEngine:
                     prec.combined_unscale_and_clip_factor(
                         total_norm, prec.static_loss_scale_state(1.0), clip)
                     if clip > 0 else 1.0)
-                new_master, new_opt = opt.update(
-                    {"flat": master_1d}, {"flat": gpart}, opt_in,
-                    lr=lr, beta1=b1, beta2=b2, weight_decay=wd,
-                    combined_scale=combined)
-                new_master = new_master["flat"]
-                if fp16:
-                    # skip-on-overflow (reference zero_optimizer.py:349-359);
-                    # bf16/fp32 have no loss-scale recovery loop — a NaN
-                    # propagates visibly, like the reference fp32 path
-                    new_master = jnp.where(overflow, master_1d, new_master)
-                    new_opt = jax.tree_util.tree_map(
-                        lambda new, old: jnp.where(overflow, old, new),
-                        new_opt, opt_in)
-                # weight all-gather (reference zero_optimizer.py:397-432)
-                flat_full = comm.allgather_params(
-                    new_master.astype(jnp.float32), DATA_AXIS,
-                    world_size=world, partition_group_size=pps)
+                def upd_seg(mseg, gseg, oin, lr_, b1_, b2_, wd_):
+                    """Shard-local update + skip-on-overflow on one flat
+                    segment (the whole partition, or one overlap bucket —
+                    elementwise, so the tiling cannot change the values).
+                    skip-on-overflow: reference zero_optimizer.py:349-359;
+                    bf16/fp32 have no loss-scale recovery loop — a NaN
+                    propagates visibly, like the reference fp32 path."""
+                    new_p, new_o = opt.update(
+                        {"flat": mseg}, {"flat": gseg}, oin,
+                        lr=lr_, beta1=b1_, beta2=b2_, weight_decay=wd_,
+                        combined_scale=combined)
+                    nm = new_p["flat"]
+                    if fp16:
+                        nm = jnp.where(overflow, mseg, nm)
+                        new_o = jax.tree_util.tree_map(
+                            lambda new, old: jnp.where(overflow, old, new),
+                            new_o, oin)
+                    return nm, new_o
+
+                hy_seg = (lambda h, s, e:
+                          {"flat": h["flat"][s:e]} if isinstance(h, dict)
+                          else h)
+                if bounds is not None and len(bounds) > 1:
+                    # software-pipelined boundary (overlap_comm): each
+                    # bucket's update → all-gather chain is data-independent
+                    # of every other bucket's, so XLA's async collectives
+                    # run gather(i-1) ∥ update(i) instead of one monolithic
+                    # update followed by one monolithic gather
+                    segs, blocks = [], []
+                    new_step = opt_in.step
+                    for s, e in bounds:
+                        oin = optim_mod.OptimizerState(
+                            step=opt_in.step,
+                            m=(None if opt_in.m is None
+                               else {"flat": opt_in.m["flat"][s:e]}),
+                            v=(None if opt_in.v is None
+                               else {"flat": opt_in.v["flat"][s:e]}))
+                        nm, new_o = upd_seg(
+                            master_1d[s:e], gpart[s:e], oin,
+                            hy_seg(lr, s, e), hy_seg(b1, s, e),
+                            hy_seg(b2, s, e), hy_seg(wd, s, e))
+                        segs.append((nm, new_o))
+                        # weight all-gather, per bucket (reference
+                        # zero_optimizer.py:397-432)
+                        blocks.append(comm.allgather_partition_bucket(
+                            nm.astype(jnp.float32), DATA_AXIS,
+                            world_size=world, partition_group_size=pps))
+                        new_step = new_o.step
+                    new_master = jnp.concatenate([nm for nm, _ in segs])
+                    cat = lambda pick: {"flat": jnp.concatenate(
+                        [pick(o) for _, o in segs])}
+                    new_opt = optim_mod.OptimizerState(
+                        step=new_step,
+                        m=(None if opt_in.m is None
+                           else cat(lambda o: o.m["flat"])),
+                        v=(None if opt_in.v is None
+                           else cat(lambda o: o.v["flat"])))
+                    flat_full = jnp.reshape(
+                        jnp.concatenate(blocks, axis=1), (-1,))
+                else:
+                    new_master, new_opt = upd_seg(master_1d, gpart, opt_in,
+                                                  lr, b1, b2, wd)
+                    # weight all-gather (reference zero_optimizer.py:397-432)
+                    flat_full = comm.allgather_params(
+                        new_master.astype(jnp.float32), DATA_AXIS,
+                        world_size=world, partition_group_size=pps)
                 params = zero_mod.unflatten_tree(flat_full, meta, dtype=cdt)
                 if zero_2d:
                     new_master = new_master[None]
@@ -1757,7 +1853,9 @@ class DeepSpeedTpuEngine:
                         return None
                     if d >= 0:
                         return g / world
-                    return comm.allreduce_grads(g, DATA_AXIS, world, **knobs)
+                    return comm.allreduce_grads(g, DATA_AXIS, world,
+                                                bucket_elems=bucket_elems,
+                                                **knobs)
 
                 grads = jax.tree_util.tree_map(
                     reduce_leaf, grads, z3_dims,
@@ -1806,6 +1904,7 @@ class DeepSpeedTpuEngine:
                     gradient_predivide_factor=cfg.gradient_predivide_factor)
                 if sparse_flags is None:
                     grads = comm.allreduce_grads(grads, DATA_AXIS, world,
+                                                 bucket_elems=bucket_elems,
                                                  **knobs)
                 else:
                     # marked leaves (embeddings) reduce as gathered
@@ -1822,6 +1921,7 @@ class DeepSpeedTpuEngine:
                                 g, DATA_AXIS, world,
                                 cfg.sparse_gradients_max_rows, **knobs)
                         return comm.allreduce_grads(g, DATA_AXIS, world,
+                                                    bucket_elems=bucket_elems,
                                                     **knobs)
 
                     grads = jax.tree_util.tree_map(

@@ -59,10 +59,11 @@ def _backbone_partition_specs() -> dict:
 
 
 def _encode(cfg, params, input_ids, attention_mask, token_type_ids,
-            z3_block_dims=None):
+            z3_block_dims=None, z3_prefetch=False):
     """Embed + encoder stack (runs inside shard_map on local shards).
     Callers must already have run ``T.zero3_enter`` on ``params`` under
-    ZeRO-3 (``z3_block_dims`` = its deferred block dims)."""
+    ZeRO-3 (``z3_block_dims`` = its deferred block dims; ``z3_prefetch``
+    pairs the per-layer gathers — transformer.scan_layers)."""
     T_len = input_ids.shape[1]
     x = L.vocab_parallel_embedding(input_ids, params["wte"])
     x = x + L.seq_shard_positions(params["wpe"], T_len).astype(
@@ -70,7 +71,7 @@ def _encode(cfg, params, input_ids, attention_mask, token_type_ids,
     x = x + jnp.take(params["wtt"].astype(x.dtype), token_type_ids, axis=0)
     x = L.layer_norm(x, params["ln_emb_s"], params["ln_emb_b"], cfg.ln_eps)
     return T.stack_apply(x, params["blocks"], cfg, attn_mask=attention_mask,
-                         z3_dims=z3_block_dims)
+                         z3_dims=z3_block_dims, z3_prefetch=z3_prefetch)
 
 
 def _zero3_min_dims(params):
@@ -102,6 +103,10 @@ class BertForPreTraining:
     mlm_gather_budget: object = None
     #: ZeRO-3 partition dims (set by the engine at stage 3; zero3.py)
     zero3_dims: object = None
+    #: ZeRO-3 gather prefetch (engine overlap_comm): paired-layer scan
+    #: hiding the second gather under the first block's compute
+    #: (transformer.scan_layers)
+    zero3_prefetch: bool = False
 
     @classmethod
     def from_size(cls, size: str, use_nsp: bool = False,
@@ -219,7 +224,8 @@ class BertForPreTraining:
 
         params, z3_deferred = T.zero3_enter(params, self.zero3_dims)
         x = _encode(cfg, params, input_ids, attention_mask, token_type_ids,
-                    z3_block_dims=z3_deferred.get("blocks"))
+                    z3_block_dims=z3_deferred.get("blocks"),
+                    z3_prefetch=getattr(self, "zero3_prefetch", False))
 
         if mlm_positions is None:
             budget = self.mlm_gather_budget
@@ -282,6 +288,10 @@ class BertForQuestionAnswering:
     config: T.TransformerConfig
     #: ZeRO-3 partition dims (set by the engine at stage 3; zero3.py)
     zero3_dims: object = None
+    #: ZeRO-3 gather prefetch (engine overlap_comm): paired-layer scan
+    #: hiding the second gather under the first block's compute
+    #: (transformer.scan_layers)
+    zero3_prefetch: bool = False
 
     @classmethod
     def from_size(cls, size: str, **overrides):
@@ -331,7 +341,8 @@ class BertForQuestionAnswering:
         cfg = self.config
         params, z3_deferred = T.zero3_enter(params, self.zero3_dims)
         x = _encode(cfg, params, input_ids, attention_mask, token_type_ids,
-                    z3_block_dims=z3_deferred.get("blocks"))
+                    z3_block_dims=z3_deferred.get("blocks"),
+                    z3_prefetch=getattr(self, "zero3_prefetch", False))
         logits = (x @ params["qa_w"].astype(x.dtype)
                   + params["qa_b"].astype(x.dtype)).astype(jnp.float32)
         return logits[..., 0], logits[..., 1]

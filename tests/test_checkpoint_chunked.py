@@ -178,9 +178,16 @@ def test_zero3_native_file_layout(tmp_path):
     qkv = raw["module"]["blocks"]["qkv_w"]
     assert ckpt_mod._z3_marker(qkv), qkv
     assert qkv[2] == dp
-    # shard files carry param + master + both moments slices
+    # shard files carry param + master + both moments slices, keyed by
+    # FLATTEN-ORDER leaf index (keystr is a debug label only — ADVICE r5:
+    # formatted key strings broke on int-keyed dicts in the state tree)
     shard = ckpt_mod._load_obj(os.path.join(d, shard_files[0]))
-    rec = shard["leaves"]["['blocks']['qkv_w']"]
+    by_keystr = {r["keystr"]: (i, r) for i, r in shard["leaves"].items()}
+    idx, rec = by_keystr["['blocks']['qkv_w']"]
+    assert isinstance(idx, int)
+    leaf_keys = [jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_leaves_with_path(eng.params)]
+    assert leaf_keys[idx] == "['blocks']['qkv_w']"
     assert rec["dim"] >= 0
     for field in ("param", "master", "m", "v"):
         assert rec[field] is not None

@@ -562,3 +562,49 @@ def test_shard_spec_pass_rank_overflow():
     rep = analysis.check_shard_specs(
         mesh, {"x": P("data", "model")}, {"x": np.ones((8,))})
     assert any(f.code == "shardspec.rank" for f in rep.errors)
+
+
+# ------------------------------------------- bucketed wire-format checks
+
+def test_divergent_bucket_shapes_detected():
+    """Collective signatures include the operand shape (the wire format):
+    two rank-divergent branches issuing the SAME primitive over the same
+    axis but with DIFFERENT bucket tilings are a real deadlock — ranks in
+    either branch would block exchanging mismatched buffers.  This is the
+    failure class the overlap_comm bucketed boundary could introduce if a
+    schedule ever bucketed per-branch."""
+    def bad(x):
+        r = lax.axis_index("data")
+
+        def bucketed(v):
+            return jnp.sum(lax.psum(v.reshape(2, 8), "data"))
+
+        def monolithic(v):
+            return jnp.sum(lax.psum(v, "data"))
+
+        return lax.cond(r > 0, bucketed, monolithic, x)
+
+    jx = jax.make_jaxpr(bad, axis_env=[("data", 2)])(jnp.ones((16,)))
+    rep = analysis.analyze_jaxpr(jx, mesh_axes=["data"])
+    errs = [f for f in rep.errors
+            if f.code == "collective.divergent-order"]
+    assert errs, rep.format()
+    assert "operand" in errs[0].message, errs[0].message
+
+
+def test_same_bucket_shapes_clean():
+    """Identical bucketed sequences in both branches stay quiet."""
+    def ok(x):
+        r = lax.axis_index("data")
+
+        def bucketed(v):
+            halves = [lax.psum(v[:8], "data"), lax.psum(v[8:], "data")]
+            return jnp.sum(jnp.concatenate(halves))
+
+        return lax.cond(r > 0, bucketed,
+                        lambda v: bucketed(v * 2.0), x)
+
+    jx = jax.make_jaxpr(ok, axis_env=[("data", 2)])(jnp.ones((16,)))
+    rep = analysis.analyze_jaxpr(jx, mesh_axes=["data"])
+    assert not [f for f in rep.errors
+                if f.code == "collective.divergent-order"], rep.format()

@@ -1,0 +1,361 @@
+"""Comm/compute overlap: bucketed software-pipelined ZeRO boundary +
+ZeRO-3 layer-prefetched gathers (zero_optimization.overlap_comm).
+
+The contract under test: bucketing only RE-TILES the same elementwise
+math — each column bucket of the [group, partition] view reduce-scatters
+exactly the serial scatter's addends onto the serial owner, the
+shard-local update is elementwise, and the bucketed gather reassembles
+the serial flat layout — so the overlapped boundary is BIT-EXACT with the
+serial path at every ZeRO stage, across grad accumulation, sub-group
+tiling, and checkpoint resume with the knob toggled.  ``DSTPU_OVERLAP=off``
+restores today's monolithic programs (one reduce-scatter, one all-gather).
+The ZeRO-3 prefetch (transformer.scan_layers) scans layer PAIRS issuing
+both gathers up front — the second hides under the first block's compute,
+the carry stays activations-only (gathered weights in the carry would be
+saved as per-iteration scan residuals, resurrecting the full unsharded
+weight set in the backward), and a scheduling barrier between the blocks
+keeps the program bitwise with the on-demand path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.parallel import comm
+from deepspeed_tpu.parallel.topology import make_mesh
+
+VOCAB, SEQ = 64, 16
+#: small enough that the tiny model's partition splits into several
+#: buckets (0.004 MB -> 1024 fp32 elements per bucket)
+BUCKET_MB = 0.004
+
+
+def tiny_gpt2(layers=2, remat=False):
+    # remat off by default: the boundary tests exercise the collective/
+    # update tiling, which is orthogonal to activation checkpointing, and
+    # the un-rematted programs compile ~2x faster on the CPU mesh.  The
+    # ZeRO-3 prefetch tests turn it back on — the remat-replayed gather
+    # is exactly what they pin.
+    return GPT2.from_size("tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                          num_layers=layers, hidden_size=32, num_heads=4,
+                          remat=remat)
+
+
+def lm_batch(batch, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, VOCAB, size=(batch, SEQ)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+    return toks, labels
+
+
+def make_engine(stage, overlap, gas=1, pps=None, layers=2, fp16=True,
+                bucket_mb=BUCKET_MB, mp=1, remat=False):
+    zero = {"stage": stage, "overlap_comm": overlap,
+            "comm_bucket_mb": bucket_mb}
+    if pps:
+        zero["parameter_parallel_size"] = pps
+    prec = ({"fp16": {"enabled": True, "initial_scale_power": 8}}
+            if fp16 else {"bf16": {"enabled": True}})
+    model = tiny_gpt2(layers, remat=remat)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 8 * gas,
+                "gradient_accumulation_steps": gas,
+                "steps_per_print": 10 ** 6,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": zero, **prec},
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(7)),
+        mesh=make_mesh(model_parallel_size=mp))
+    return engine
+
+
+def run_fused(engine, steps=2):
+    gas = engine.gradient_accumulation_steps()
+    return [float(engine.train_batch(lm_batch(8 * gas, seed=i)))
+            for i in range(steps)]
+
+
+def assert_params_bitwise(a, b, msg=""):
+    for (pa, la), (_, lb) in zip(
+            jax.tree_util.tree_leaves_with_path(a),
+            jax.tree_util.tree_leaves_with_path(b)):
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=f"{msg} {jax.tree_util.keystr(pa)}")
+
+
+def host_params(engine):
+    return jax.tree_util.tree_map(np.asarray, engine.params)
+
+
+# ------------------------------------------------------- bucket geometry
+
+def test_bucket_bounds():
+    # covers [0, total), aligned starts, <= one aligned step each
+    assert comm.bucket_bounds(1024, 4096) == ((0, 1024),)
+    assert comm.bucket_bounds(1024, 256) == (
+        (0, 256), (256, 512), (512, 768), (768, 1024))
+    # bucket_elems floors to the 128 lane; sub-lane requests clamp to 128
+    assert comm.bucket_bounds(256, 1) == ((0, 128), (128, 256))
+    # non-multiple totals: the tail bucket is short
+    assert comm.bucket_bounds(640, 256) == ((0, 256), (256, 512), (512, 640))
+    for total, be in ((1024, 256), (640, 333), (128, 1)):
+        bounds = comm.bucket_bounds(total, be)
+        assert bounds[0][0] == 0 and bounds[-1][1] == total
+        assert all(s < e for s, e in bounds)
+        assert all(a[1] == b[0] for a, b in zip(bounds, bounds[1:]))
+        assert all(s % 128 == 0 for s, _ in bounds)
+
+
+def test_config_knobs():
+    e = make_engine(1, True, bucket_mb=0.5)
+    assert e.overlap_comm and e.comm_bucket_elems == 0.5 * (1 << 20) // 4
+    assert len(e._comm_buckets()) >= 1
+    e = make_engine(1, False)
+    assert not e.overlap_comm and e._comm_buckets() is None
+    with pytest.raises(DeepSpeedConfigError, match="comm_bucket_mb"):
+        make_engine(1, True, bucket_mb=0)
+    with pytest.raises(DeepSpeedConfigError, match="comm_bucket_mb"):
+        make_engine(1, True, bucket_mb="huge")
+    # a zeroed-out bucket with overlap already off is a valid spelling of
+    # "disabled", not a config error
+    assert not make_engine(1, False, bucket_mb=0).overlap_comm
+
+
+def test_dstpu_overlap_env(monkeypatch):
+    monkeypatch.setenv("DSTPU_OVERLAP", "off")
+    assert not make_engine(1, True).overlap_comm
+    monkeypatch.setenv("DSTPU_OVERLAP", "on")
+    assert make_engine(1, False).overlap_comm
+    monkeypatch.setenv("DSTPU_OVERLAP", "sideways")
+    with pytest.raises(DeepSpeedConfigError, match="DSTPU_OVERLAP"):
+        make_engine(1, True)
+
+
+# ------------------------------------------------- bit-exactness, fused
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_overlap_bitexact_fused(stage):
+    """train_batch trajectories and final params are BITWISE identical
+    with the bucketed/pipelined boundary vs the serial path."""
+    remat = stage == 3    # stage 3: pin the remat-replayed prefetched bwd
+    eo = make_engine(stage, True, remat=remat)
+    es = make_engine(stage, False, remat=remat)
+    assert eo.overlap_comm and not es.overlap_comm
+    lo, ls = run_fused(eo), run_fused(es)
+    assert lo == ls, (stage, lo, ls)
+    assert_params_bitwise(host_params(eo), host_params(es),
+                          f"stage {stage}")
+
+
+def test_overlap_bitexact_gas_boundary():
+    """gas > 1 (stage 2 — the stage where the bucketed scatter runs
+    INSIDE the accumulation loop): per-micro bucketed scatters accumulate
+    into the same partition the serial scatter fills — bitwise at the gas
+    boundary."""
+    eo, es = make_engine(2, True, gas=2), make_engine(2, False, gas=2)
+    assert run_fused(eo) == run_fused(es)
+    assert_params_bitwise(host_params(eo), host_params(es), "stage 2 gas 2")
+
+
+@pytest.mark.slow
+def test_overlap_bitexact_split_api():
+    """Split API (forward/backward/step): same buckets, same bits.
+    (slow tier: beyond the tier-1 matrix — the boundary program under
+    test is the same _make_step_local the fused legs pin.)"""
+    def run(overlap):
+        engine = make_engine(1, overlap)
+        out = []
+        for i in range(3):
+            loss = engine(*lm_batch(8, seed=i))
+            engine.backward(loss)
+            engine.step()
+            out.append(float(loss))
+        return out, host_params(engine)
+
+    lo, po = run(True)
+    ls, ps = run(False)
+    assert lo == ls
+    assert_params_bitwise(po, ps, "split API")
+
+
+@pytest.mark.slow
+def test_overlap_bitexact_zero_x_mp():
+    """ZeRO-1 x tensor parallelism: the [S, local] row layout buckets its
+    squeezed 1-D partition exactly like the plain layout — bitwise.
+    (slow tier: the zero_2d bucket path also runs overlap-on in the
+    MULTICHIP dryrun's zero-1 tp=2 leg.)"""
+    eo, es = make_engine(1, True, mp=2), make_engine(1, False, mp=2)
+    assert run_fused(eo, steps=2) == run_fused(es, steps=2)
+    assert_params_bitwise(host_params(eo), host_params(es), "mp=2")
+
+
+def test_overlap_bitexact_pps_subgroups():
+    """parameter_parallel_size < dp: buckets tile the [pps, partition]
+    view with axis_index_groups — still bitwise vs serial."""
+    eo, es = make_engine(1, True, pps=4), make_engine(1, False, pps=4)
+    assert run_fused(eo) == run_fused(es)
+    assert_params_bitwise(host_params(eo), host_params(es), "pps=4")
+
+
+def test_overlap_bitexact_zero3_prefetch_bf16():
+    """ZeRO-3 prefetched gathers vs on-demand, bf16 (the dtype where a
+    non-uniform scan body showed ulp drift): bitwise over 3 steps."""
+    eo = make_engine(3, True, fp16=False, remat=True)
+    es = make_engine(3, False, fp16=False, remat=True)
+    assert eo.module.zero3_prefetch and not es.module.zero3_prefetch
+    assert run_fused(eo) == run_fused(es)
+    assert_params_bitwise(host_params(eo), host_params(es), "zero3 bf16")
+
+
+# ------------------------------------------------- program-shape evidence
+
+def _step_collective_counts(engine, batch):
+    """reduce-scatter / all-gather equation counts of the fused step
+    program (static jaxpr evidence that the bucketed boundary really
+    issues K independent collectives)."""
+    from deepspeed_tpu import analysis
+    from deepspeed_tpu.analysis import graph as G
+
+    jaxpr = analysis.trace_train_batch(
+        engine, batch, fn=engine._build_train_batch(batch))
+    counts = {"reduce_scatter": 0, "all_gather": 0}
+    for eqn, _ in G.walk(jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name == "psum_scatter":
+            name = "reduce_scatter"
+        if name in counts:
+            counts[name] += 1
+    return counts
+
+
+def test_bucketed_program_issues_k_collectives():
+    batch = lm_batch(8)
+    eo, es = make_engine(1, True), make_engine(1, False)
+    k = len(eo._comm_buckets())
+    assert k > 1, "test needs a multi-bucket partition"
+    co = _step_collective_counts(eo, batch)
+    cs = _step_collective_counts(es, batch)
+    # overlap: one reduce-scatter and one all-gather PER BUCKET;
+    # DSTPU_OVERLAP=off / overlap_comm=false: the monolithic pair
+    assert co == {"reduce_scatter": k, "all_gather": k}, co
+    assert cs == {"reduce_scatter": 1, "all_gather": 1}, cs
+
+
+def test_zero3_prefetch_memory_envelope():
+    """The prefetch scan's residuals must NOT hold gathered weights: a
+    gathered layer threaded through the scan carry would be saved per
+    iteration, resurrecting the full unsharded weight set in the backward
+    (the review-caught failure mode).  Pinned via XLA's memory analysis:
+    prefetch temp memory stays within on-demand + ~2 gathered layers."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu import zero3 as Z
+    from deepspeed_tpu.models import transformer as T
+
+    L_ = 8
+    cfg = T.TransformerConfig(vocab_size=256, max_seq_len=8,
+                              hidden_size=256, num_layers=L_, num_heads=4)
+    blocks = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), T.init_block_params(cfg,
+                                                              jax.random.PRNGKey(1)))
+    specs = T.block_partition_specs()
+    dims = Z.choose_dims(blocks, specs, {"data": 8, "model": 1}, 8,
+                         min_dims=jax.tree_util.tree_map(lambda _: 1,
+                                                         blocks))
+    aspecs = Z.augment_specs(specs, dims)
+    mesh = make_mesh()
+    x = jax.random.normal(jax.random.PRNGKey(2),
+                          (1, 8, 256)).astype(jnp.bfloat16)
+
+    def temp_bytes(prefetch):
+        def local(b, xx):
+            y = T.stack_apply(xx, b, cfg, z3_dims=dims,
+                              z3_prefetch=prefetch)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+        f = jax.jit(jax.shard_map(
+            lambda b, xx: jax.value_and_grad(local)(b, xx), mesh=mesh,
+            in_specs=(aspecs, P()), out_specs=(P(), aspecs),
+            check_vma=False))
+        bp = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(
+                v, jax.sharding.NamedSharding(mesh, s)), blocks, aspecs)
+        return f.lower(bp, x).compile().memory_analysis().temp_size_in_bytes
+
+    gathered_layer = sum(
+        int(np.prod(l.shape[1:])) * 2    # bf16
+        for l in jax.tree_util.tree_leaves(blocks))
+    on_demand, prefetch = temp_bytes(False), temp_bytes(True)
+    # two transient layers + scheduling slack, NOT L x gathered-layer
+    budget = on_demand + 3 * gathered_layer
+    assert prefetch <= budget, (
+        f"prefetch temp {prefetch} exceeds on-demand {on_demand} + 3 "
+        f"gathered layers ({gathered_layer} each): scan residuals are "
+        f"holding gathered weights")
+
+
+def test_lint_clean_with_overlap():
+    """Graph-lint regression: the bucketed/prefetched collective
+    sequences are rank-uniform — zero error-severity findings on the
+    overlap-on step programs at every stage."""
+    for stage in (1, 2, 3):
+        engine = make_engine(stage, True)
+        rep = engine.run_graph_lint(lm_batch(8), train=True)
+        assert not rep.errors, f"stage {stage}:\n" + rep.format()
+
+
+# ------------------------------------------------------- resume parity
+
+def test_resume_with_overlap_toggled(tmp_path):
+    """State layouts are identical under overlap (bucketing never touches
+    the persistent flat layout), so a checkpoint saved with overlap ON
+    resumes bit-compatibly with overlap OFF — the resumed trajectory
+    matches the unbroken serial run."""
+    ref = run_fused(make_engine(1, False), steps=5)
+    saver = make_engine(1, True)
+    run_fused(saver, steps=3)
+    saver.save_checkpoint(str(tmp_path), tag="ov1")
+    resumed = make_engine(1, False)   # overlap toggled off
+    resumed.load_checkpoint(str(tmp_path), tag="ov1")
+    post = [float(resumed.train_batch(lm_batch(8, seed=i)))
+            for i in (3, 4)]
+    np.testing.assert_allclose(post, ref[3:], rtol=1e-6, atol=1e-7)
+    # stage 3's persistent layout is likewise untouched by overlap (the
+    # prefetch only reorders gathers); its resume parity is pinned by
+    # tests/test_zero3.py::test_zero3_checkpoint_resume_parity running
+    # with the default overlap_comm=true
+
+
+# ------------------------------------------------- bucketed plain psum
+
+def test_allreduce_grads_bucketed_matches_monolithic():
+    """comm.allreduce_grads(bucket_elems=...) chunks big leaves into
+    independent psums — elementwise identical to the whole-leaf psum."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh()
+    rng = np.random.default_rng(0)
+    grads = {"big": jnp.asarray(rng.normal(size=(8, 40, 33)),
+                                jnp.float32),
+             "small": jnp.asarray(rng.normal(size=(8, 7)), jnp.float32)}
+
+    def run(bucket_elems):
+        def local(g):
+            return comm.allreduce_grads(
+                g, "data", 8, fp32_allreduce=True,
+                prescale_gradients=True, gradient_predivide_factor=2.0,
+                bucket_elems=bucket_elems)
+        f = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=({"big": P("data"), "small": P("data")},),
+            out_specs={"big": P("data"), "small": P("data")},
+            check_vma=False))
+        return jax.tree_util.tree_map(np.asarray, f(grads))
+
+    assert_params_bitwise(run(200), run(None), "bucketed psum")
