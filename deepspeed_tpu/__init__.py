@@ -12,7 +12,9 @@ attention), ``tokenization`` + ``squad`` (wordpiece pipeline),
 ``metrics``, ``checkpoint`` (incl. ``load_module_tree``/
 ``init_from_module_tree`` transfer), ``ops`` (optimizers incl. Lion +
 Pallas kernels), ``parallel`` (mesh/collectives/pipeline), ``zero3``
-(parameter-partitioning helpers).
+(parameter-partitioning helpers), ``resilience`` (preemption-safe
+training, auto-resume, hang watchdog, fault injection —
+docs/resilience.md).
 """
 
 from deepspeed_tpu import compat as _compat  # noqa: F401  (installs jax shims)

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import re
 from typing import Any, Optional
 
 import jax
@@ -43,6 +44,7 @@ import numpy as np
 from deepspeed_tpu import zero as zero_mod
 from deepspeed_tpu.parallel.topology import (DATA_AXIS, MODEL_AXIS,
                                              PIPE_AXIS)
+from deepspeed_tpu.resilience import chaos as _chaos
 
 MODEL_FILE = "mp_rank_{mp:02d}_model_states.pt"
 # pipeline stages get their own model-state files (generalizing the
@@ -153,6 +155,7 @@ class _ChunkedWriter:
         return obj
 
     def finish(self, header: Any) -> None:
+        _chaos.io_point("ckpt_write")   # chaos tier: Nth-write IO failure
         header = self._escape(header)
         off = self._f.tell()
         pickle.dump(header, self._f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -810,8 +813,16 @@ def _publish(engine, save_dir, tag, path, S, mp, pp):
                      and f not in expected)
             if stale:
                 os.remove(os.path.join(path, f))
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+        # atomic pointer publish: a crash mid-write must never leave a
+        # truncated/empty `latest` that breaks every future resume (the
+        # same temp + os.replace contract as the state files themselves)
+        latest = os.path.join(save_dir, LATEST_FILE)
+        tmp = latest + ".tmp"
+        with open(tmp, "w") as f:
             f.write(tag)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, latest)
     # second barrier: by the time ANY process returns, the pointer is
     # visible — tests/distributed/workers.py pins this contract
     if jax.process_count() > 1:
@@ -945,6 +956,98 @@ def _zero_checkpoint_writes(engine, save_dir: str, tag: str):
     return writes
 
 
+# ------------------------------------------------------- tag discovery
+
+def _model_probe(load_dir: str, tag: str) -> Optional[str]:
+    """Path of the tag's canonical model-state file (mp rank 0 / stage 0),
+    or None when neither layout's file exists."""
+    mfile = model_file(load_dir, tag, 0)
+    if os.path.exists(mfile):
+        return mfile
+    mfile = os.path.join(load_dir, tag, MODEL_FILE_PP.format(pp=0, mp=0))
+    return mfile if os.path.exists(mfile) else None
+
+
+def validate_tag(load_dir: str, tag: str) -> bool:
+    """True when ``tag`` looks restorable: its canonical model-state file
+    exists and its container header parses.  Cheap (header-only; chunk
+    payloads resolve to lazy memmaps) — the auto-resume discovery runs it
+    over every candidate, so a half-written or corrupt tag is skipped
+    instead of crashing the restart (docs/resilience.md)."""
+    probe = _model_probe(load_dir, tag)
+    if probe is None:
+        return False
+    try:
+        _load_obj(probe)
+    except Exception:
+        return False
+    return True
+
+
+def list_tags(load_dir: str) -> list:
+    """Candidate tag names under ``load_dir``: every direct tag directory
+    plus ``emergency/<tag>`` preemption-drain tags."""
+    out = []
+    try:
+        entries = sorted(os.listdir(load_dir))
+    except OSError:
+        return out
+    for e in entries:
+        p = os.path.join(load_dir, e)
+        if not os.path.isdir(p):
+            continue
+        if e == "emergency":
+            try:
+                subs = sorted(os.listdir(p))
+            except OSError:
+                continue
+            out.extend(f"emergency/{s}" for s in subs
+                       if os.path.isdir(os.path.join(p, s)))
+        else:
+            out.append(e)
+    return out
+
+
+def _tag_step(tag: str) -> int:
+    """Trailing step number of a tag (``global_step12`` → 12; -1 when the
+    tag carries none) — NUMERIC, so the mtime tie-break cannot misorder
+    ``global_step9`` above ``global_step10`` lexicographically."""
+    m = re.search(r"(\d+)$", tag)
+    return int(m.group(1)) if m else -1
+
+
+def find_latest_valid_tag(load_dir: str, exclude=()) -> Optional[str]:
+    """Newest VALID checkpoint tag under ``load_dir`` — the auto-resume
+    discovery (resilience.run_resumable).  "Newest" is by model-state-file
+    mtime (trailing step number, then tag name, as deterministic
+    tie-breaks for coarse-mtime or copy-flattened filesystems), over
+    regular AND ``emergency/`` tags; tags whose model-state header does
+    not parse are skipped, as are ``exclude``d tags (the resume driver
+    passes tags that already failed a full load — e.g. a mid-save SIGKILL
+    left the model header durable but the ZeRO shard files missing, which
+    a header-only probe cannot see — so discovery falls back to the
+    next-newest candidate instead of bricking every restart on the same
+    half-written tag).  The ``latest`` pointer is NOT trusted blindly: a
+    stale or corrupt pointer must not hide a newer (or the only) valid
+    checkpoint."""
+    best = None
+    excluded = set(exclude)
+    for tag in list_tags(load_dir):
+        if tag in excluded:
+            continue
+        probe = _model_probe(load_dir, tag)
+        if probe is None:
+            continue
+        try:
+            _load_obj(probe)        # validate_tag's check, probe reused
+        except Exception:
+            continue
+        key = (os.path.getmtime(probe), _tag_step(tag), tag)
+        if best is None or key > best[0]:
+            best = (key, tag)
+    return None if best is None else best[1]
+
+
 # ------------------------------------------------------------------ loading
 
 def load_module_tree(load_dir: str, tag: Optional[str] = None, specs=None):
@@ -1043,17 +1146,30 @@ def _read_model_states(load_dir: str, tag: Optional[str]):
     None when no checkpoint exists."""
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest):
-            return None
-        with open(latest) as f:
-            tag = f.read().strip()
-    mfile = model_file(load_dir, tag, 0)
-    if not os.path.exists(mfile):
-        # pp>1 saves use per-stage file names; the template does not embed
-        # the pp degree, so stage 0 / mp rank 0 is the canonical probe
-        mfile = os.path.join(load_dir, tag, MODEL_FILE_PP.format(pp=0, mp=0))
-        if not os.path.exists(mfile):
-            return None
+        tag = None
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip() or None
+        if tag is None or not validate_tag(load_dir, tag):
+            # a corrupt/empty/stale `latest` (crash mid-publish, deleted
+            # tag dir) must not break resume: fall back to the newest
+            # valid tag directory on disk (regression-pinned in
+            # tests/test_resilience.py)
+            fallback = find_latest_valid_tag(load_dir)
+            if tag is not None and fallback is not None:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "checkpoint `latest` pointer (%r) is corrupt or names "
+                    "an invalid tag; falling back to newest valid tag %r",
+                    tag, fallback)
+            tag = fallback
+            if tag is None:
+                return None
+    mfile = _model_probe(load_dir, tag)
+    if mfile is None:
+        # (explicit-tag path; the canonical probe covers both the mp_rank
+        # and the pp>1 per-stage file layouts)
+        return None
     state = _load_obj(mfile)
     saved_mp = int(state.get("mp_world_size", 1))
     saved_pp = int(state.get("pp_world_size", 1))
@@ -1063,6 +1179,31 @@ def _read_model_states(load_dir: str, tag: Optional[str]):
         for r in range(1, saved_pp * saved_mp)]
     states = _zero3_rehydrate(load_dir, tag, states)
     return tag, states, saved_mp, saved_pp
+
+
+def _put_global(old, new):
+    """Place the host-global value ``new`` on devices with ``old``'s
+    sharding and dtype, WITHOUT collectives.
+
+    ``jax.device_put`` of a host value whose target sharding spans
+    processes first runs ``multihost_utils.assert_equal`` — a full-array
+    cross-host broadcast per LEAF.  Across a restore that is O(model
+    bytes) of gloo/ICI traffic for values every host just read from the
+    same files, and the per-leaf broadcast stream was the desync surface
+    the chaos tier kept tripping (a lagging rank pairs broadcast k with
+    k+1 and the transport aborts).  ``make_array_from_callback`` builds
+    the array from locally-addressable shards with no cross-process
+    traffic at all."""
+    arr = np.asarray(new, old.dtype)
+    if arr.shape != tuple(old.shape):
+        raise ValueError(
+            f"checkpoint restore: loaded value has shape {arr.shape}, "
+            f"engine expects {tuple(old.shape)}")
+    sharding = old.sharding
+    if sharding.is_fully_addressable:
+        return jax.device_put(jnp.asarray(arr), sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
 
 
 def init_from_module_tree(engine, module) -> tuple:
@@ -1081,7 +1222,7 @@ def init_from_module_tree(engine, module) -> tuple:
         new = src.get(key)
         if new is not None and tuple(np.shape(new)) == tuple(old.shape):
             loaded.append(key)
-            return jax.device_put(jnp.asarray(new, old.dtype), old.sharding)
+            return _put_global(old, new)
         skipped.append(key)
         return old
 
@@ -1108,10 +1249,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     saved_axes = _state_axes(saved_pp, saved_mp)
     module = _combine_shard_states([s["module"] for s in states],
                                    engine._param_specs, saved_axes)
-    engine.params = jax.tree_util.tree_map(
-        lambda old, new: jax.device_put(
-            jnp.asarray(new, old.dtype), old.sharding),
-        engine.params, module)
+    engine.params = jax.tree_util.tree_map(_put_global, engine.params,
+                                           module)
 
     # counters — reference :1014-1017
     engine.global_steps = int(state["global_steps"])
@@ -1166,10 +1305,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                       else _combine_shard_states(v_trees,
                                                  engine._param_specs,
                                                  saved_axes))
-            engine.master = jax.tree_util.tree_map(
-                lambda old, new: jax.device_put(
-                    jnp.asarray(new, old.dtype), old.sharding),
-                engine.master, master)
+            engine.master = jax.tree_util.tree_map(_put_global,
+                                                   engine.master, master)
             engine.opt_state = type(engine.opt_state)(
                 step=jnp.asarray(state["optimizer"]["opt_state"]["step"]),
                 m=_put_like(engine.opt_state.m, m_tree),
@@ -1206,9 +1343,7 @@ def _rederive_masters(engine) -> None:
 def _put_like(old_tree, new_tree):
     if old_tree is None:
         return None
-    return jax.tree_util.tree_map(
-        lambda old, new: jax.device_put(jnp.asarray(new), old.sharding),
-        old_tree, new_tree)
+    return jax.tree_util.tree_map(_put_global, old_tree, new_tree)
 
 
 def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
@@ -1266,14 +1401,11 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
                          for m in range(rows)])
 
     host_master = stack("master")
-    engine.master_flat = jax.device_put(jnp.asarray(host_master),
-                                        engine.master_flat.sharding)
+    engine.master_flat = _put_global(engine.master_flat, host_master)
     engine.opt_state = type(engine.opt_state)(
         step=jnp.asarray(table[0][0]["step"]),
-        m={"flat": jax.device_put(jnp.asarray(stack("m")),
-                                  engine.opt_state.m["flat"].sharding)},
-        v={"flat": jax.device_put(jnp.asarray(stack("v")),
-                                  engine.opt_state.v["flat"].sharding)})
+        m={"flat": _put_global(engine.opt_state.m["flat"], stack("m"))},
+        v={"flat": _put_global(engine.opt_state.v["flat"], stack("v"))})
     # params re-derived from the HOST copy of the restored master (bit-exact
     # resume; never device_gets the sharded global array — multi-host safe)
     engine.params = engine._params_from_master_flat(host_master)

@@ -267,6 +267,49 @@ class DeepSpeedConfig:
                 f"rule-code prefixes, got {sup!r}")
         self.graph_lint_suppress = list(sup)
 
+        # resilience: preemption-safe training, hang watchdog, NaN
+        # sentinel, storage retry (deepspeed_tpu/resilience/,
+        # docs/resilience.md)
+        res = pd.get(C.RESILIENCE, None)
+        if res is not None and not isinstance(res, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.RESILIENCE}' must be a JSON object, got {res!r}")
+        known = {C.RESILIENCE_PREEMPT_SAVE, C.RESILIENCE_MAX_RESTARTS,
+                 C.RESILIENCE_WATCHDOG_TIMEOUT_S,
+                 C.RESILIENCE_WATCHDOG_ABORT, C.RESILIENCE_IO_RETRIES,
+                 C.RESILIENCE_NAN_SENTINEL}
+        if res is not None and set(res) - known:
+            # a typo'd key here would silently run WITHOUT the intended
+            # protection — the one config family where that must be loud
+            raise DeepSpeedConfigError(
+                f"unknown {C.RESILIENCE} key(s) {sorted(set(res) - known)}; "
+                f"supported: {sorted(known)}")
+        self.resilience_preempt_save = bool(get_scalar_param(
+            res, C.RESILIENCE_PREEMPT_SAVE, C.RESILIENCE_PREEMPT_SAVE_DEFAULT))
+        self.resilience_max_restarts = int(get_scalar_param(
+            res, C.RESILIENCE_MAX_RESTARTS, C.RESILIENCE_MAX_RESTARTS_DEFAULT))
+        self.resilience_watchdog_timeout_s = float(get_scalar_param(
+            res, C.RESILIENCE_WATCHDOG_TIMEOUT_S,
+            C.RESILIENCE_WATCHDOG_TIMEOUT_S_DEFAULT))
+        self.resilience_watchdog_abort = bool(get_scalar_param(
+            res, C.RESILIENCE_WATCHDOG_ABORT,
+            C.RESILIENCE_WATCHDOG_ABORT_DEFAULT))
+        self.resilience_io_retries = int(get_scalar_param(
+            res, C.RESILIENCE_IO_RETRIES, C.RESILIENCE_IO_RETRIES_DEFAULT))
+        self.resilience_nan_sentinel = bool(get_scalar_param(
+            res, C.RESILIENCE_NAN_SENTINEL,
+            C.RESILIENCE_NAN_SENTINEL_DEFAULT))
+        if self.resilience_max_restarts < 0:
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_MAX_RESTARTS} must be >= 0")
+        if self.resilience_watchdog_timeout_s < 0:
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_WATCHDOG_TIMEOUT_S} must be "
+                f">= 0 (0 disables the watchdog)")
+        if self.resilience_io_retries < 0:
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_IO_RETRIES} must be >= 0")
+
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)
         prof = pd.get(C.PROFILE, None) or {}

@@ -171,6 +171,37 @@ PROFILE_OUTPUT_PATH = "output_path"
 PROFILE_OUTPUT_PATH_DEFAULT = "/tmp/dstpu_profile"
 
 #############################################
+# Resilience (TPU-native: preemption-safe training, hang watchdog, NaN
+# sentinel, storage retry — deepspeed_tpu/resilience/, docs/resilience.md.
+# No reference analog: v0.1.0 assumes every host survives the run.)
+#############################################
+RESILIENCE = "resilience"
+# take an emergency checkpoint (tag "emergency/...") before a preemption
+# drain exits with RESUME_EXIT_CODE
+RESILIENCE_PREEMPT_SAVE = "preempt_save"
+RESILIENCE_PREEMPT_SAVE_DEFAULT = True
+# launcher relaunch budget after RESUME/WATCHDOG exit codes (the engine
+# records it; deepspeed_tpu.launcher --max_restarts consumes it via CLI)
+RESILIENCE_MAX_RESTARTS = "max_restarts"
+RESILIENCE_MAX_RESTARTS_DEFAULT = 0
+# hang watchdog deadline over each blocking step/checkpoint call;
+# 0 disables the watchdog
+RESILIENCE_WATCHDOG_TIMEOUT_S = "watchdog_timeout_s"
+RESILIENCE_WATCHDOG_TIMEOUT_S_DEFAULT = 0.0
+# after the stack dump, abort the process with WATCHDOG_EXIT_CODE so the
+# restart path takes over (default: dump only)
+RESILIENCE_WATCHDOG_ABORT = "watchdog_abort"
+RESILIENCE_WATCHDOG_ABORT_DEFAULT = False
+# retry-with-backoff budget for checkpoint save/load storage errors
+RESILIENCE_IO_RETRIES = "io_retries"
+RESILIENCE_IO_RETRIES_DEFAULT = 3
+# extend the fp16 skip-on-overflow contract to bf16/fp32: a non-finite
+# gradient skips the optimizer boundary (master/moments unchanged) instead
+# of poisoning the parameters
+RESILIENCE_NAN_SENTINEL = "nan_sentinel"
+RESILIENCE_NAN_SENTINEL_DEFAULT = False
+
+#############################################
 # TensorBoard (reference deepspeed_constants.py:225-245)
 #############################################
 TENSORBOARD = "tensorboard"

@@ -98,10 +98,41 @@ class DeepSpeedDataLoader:
         self._sharding = None
         if mesh is not None:
             self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # resumable-iterator position (docs/resilience.md): batches YIELDED
+        # in the current epoch, and the skip count the next __iter__ honours
+        # after load_state_dict
+        self._batch_pos = 0
+        self._resume_pos = 0
 
     def set_epoch(self, epoch: int) -> None:
         """DistributedSampler.set_epoch equivalent: reseeds the shuffle."""
         self.epoch = int(epoch)
+
+    # ------------------------------------------------------- resume state
+
+    def state_dict(self) -> dict:
+        """Snapshot the iterator position: epoch, batches consumed within
+        it, and the shuffle seed (the RNG key — each epoch's permutation is
+        ``default_rng(seed + epoch)``, so (seed, epoch, batch) pins the
+        exact sample stream).  Taken at a step boundary it makes the
+        loader resumable mid-epoch: a fresh loader given this dict yields
+        exactly the batches the interrupted run never consumed
+        (resilience.run_resumable stores it in every checkpoint's
+        client_state)."""
+        return {"epoch": int(self.epoch), "batch": int(self._batch_pos),
+                "seed": int(self.seed)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.epoch = int(sd["epoch"])
+        self.seed = int(sd.get("seed", self.seed))
+        pos = int(sd["batch"])
+        if not 0 <= pos <= self.len:
+            raise ValueError(
+                f"data iterator state batch={pos} is outside this loader's "
+                f"epoch ({self.len} batches) — different dataset or "
+                f"batch size than the saving run?")
+        self._resume_pos = pos
+        self._batch_pos = pos
 
     def _indices(self) -> np.ndarray:
         n = len(self.dataset)
@@ -137,12 +168,12 @@ class DeepSpeedDataLoader:
         samples = [self.dataset[int(i)] for i in sel]
         return self.collate_fn(samples)
 
-    def _batches(self, idx: np.ndarray):
-        for b in range(self.len):
+    def _batches(self, idx: np.ndarray, start: int = 0):
+        for b in range(start, self.len):
             yield self._make_batch(idx[b * self.batch_size:
                                        (b + 1) * self.batch_size])
 
-    def _prefetched(self, idx: np.ndarray):
+    def _prefetched(self, idx: np.ndarray, start: int = 0):
         """Producer thread keeps up to ``prefetch_depth`` collated batches
         ready while the device computes (torch DataLoader worker analog).
         Abandoning the iterator early (break / GC) signals the producer to
@@ -162,7 +193,7 @@ class DeepSpeedDataLoader:
 
         def produce():
             try:
-                for batch in self._batches(idx):
+                for batch in self._batches(idx, start):
                     # device placement on the producer: jax.device_put is
                     # async (returns after enqueueing the DMA), so with
                     # queue depth >= 2 the NEXT batch's host->device copy
@@ -193,25 +224,34 @@ class DeepSpeedDataLoader:
 
     def __iter__(self) -> Iterator[Any]:
         idx = self._indices()
+        # honour a restored mid-epoch position exactly once: the epoch's
+        # permutation is (seed, epoch)-deterministic, so skipping the first
+        # `start` batches replays the interrupted epoch's remainder
+        start = self._resume_pos
+        self._resume_pos = 0
+        self._batch_pos = start
         if self.num_workers > 0:
             # collation (and, with device_prefetch, the host->device copy)
             # runs concurrently on the producer; the timed span covers
             # dequeue (+ placement only when device_prefetch is off)
-            for batch in self._prefetched(idx):
+            for batch in self._prefetched(idx, start):
                 if self.tput_timer is not None:
                     self.tput_timer.start()
+                self._batch_pos += 1
                 yield (batch if self.device_prefetch
                        else self._place(batch))
         else:
             # synchronous path: collation stays inside the timed span, like
             # the reference hooking the timer in __next__
-            for b in range(self.len):
+            for b in range(start, self.len):
                 if self.tput_timer is not None:
                     self.tput_timer.start()
                 batch = self._make_batch(idx[b * self.batch_size:
                                              (b + 1) * self.batch_size])
+                self._batch_pos += 1
                 yield self._place(batch)
         self.epoch += 1
+        self._batch_pos = 0
 
 
 class FileDataset:

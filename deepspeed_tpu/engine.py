@@ -628,6 +628,17 @@ class DeepSpeedTpuEngine:
             self._ls_variant = prec.INLINE
             self.loss_scale_state = prec.static_loss_scale_state(1.0)
 
+        # -- resilience (docs/resilience.md): NaN/Inf sentinel extends the
+        #    fp16 skip-on-overflow contract to bf16/fp32 boundaries; the
+        #    hang watchdog arms around every blocking engine call
+        self._nan_sentinel = bool(self.config.resilience_nan_sentinel)
+        self._watchdog = None
+        if self.config.resilience_watchdog_timeout_s > 0:
+            from deepspeed_tpu.resilience import Watchdog
+            self._watchdog = Watchdog(
+                self.config.resilience_watchdog_timeout_s,
+                abort=self.config.resilience_watchdog_abort)
+
         # -- sanity (reference _do_sanity_check :404-413: LAMB needs dynamic
         #    loss scaling under fp16)
         if (self.config.fp16_enabled and not self.config.dynamic_loss_scale
@@ -1119,6 +1130,21 @@ class DeepSpeedTpuEngine:
     def is_gradient_accumulation_boundary(self):
         """reference deepspeed_light.py:698-706"""
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
+
+    def _armed(self, label):
+        """Watchdog-armed context for a blocking call (nullcontext when the
+        resilience watchdog is off — docs/resilience.md)."""
+        if self._watchdog is None:
+            from contextlib import nullcontext
+            return nullcontext()
+        return self._watchdog.armed(label)
+
+    def resilience_counters(self) -> dict:
+        """Process-wide resilience counters (restarts, skipped-NaN steps,
+        IO retries, watchdog near-misses/fires) — also exported as
+        Train/Resilience/* TensorBoard scalars at every boundary."""
+        from deepspeed_tpu.resilience import COUNTERS
+        return COUNTERS.as_dict()
 
     # ------------------------------------------------------------- data layer
 
@@ -1617,7 +1643,8 @@ class DeepSpeedTpuEngine:
             # step; reference's backward_inner span = the model bwd compute)
             if wcb:
                 self.timers(BACKWARD_INNER_TIMER).start()
-            self._pending.force()
+            with self._armed("backward (fused fwd+bwd)"):
+                self._pending.force()
             if wcb:
                 self.timers(BACKWARD_INNER_TIMER).stop(
                     sync_on=self._pending.loss)
@@ -1666,6 +1693,11 @@ class DeepSpeedTpuEngine:
         cfg = self.config
         world = self.dp_world_size
         fp16 = cfg.fp16_enabled
+        # skip-on-non-finite guard: always under fp16 (the loss-scale FSM
+        # needs the skip), and under ANY precision when the resilience NaN
+        # sentinel is on — a non-finite gradient then leaves master/moments
+        # untouched instead of poisoning the run (docs/resilience.md)
+        skip_bad = fp16 or self._nan_sentinel
         clip = self.clip_grad
         variant = self._ls_variant
         zero = self.zero_flat
@@ -1775,7 +1807,7 @@ class DeepSpeedTpuEngine:
                         lr=lr_, beta1=b1_, beta2=b2_, weight_decay=wd_,
                         combined_scale=combined)
                     nm = new_p["flat"]
-                    if fp16:
+                    if skip_bad:
                         nm = jnp.where(overflow, mseg, nm)
                         new_o = jax.tree_util.tree_map(
                             lambda new, old: jnp.where(overflow, old, new),
@@ -1886,7 +1918,7 @@ class DeepSpeedTpuEngine:
                     master, grads, opt_state,
                     lr=lr, beta1=b1, beta2=b2, weight_decay=wd,
                     combined_scale=combined)
-                if fp16:
+                if skip_bad:
                     new_master = jax.tree_util.tree_map(
                         lambda new, old: jnp.where(overflow, old, new),
                         new_master, master)
@@ -1938,7 +1970,7 @@ class DeepSpeedTpuEngine:
                     master, grads, opt_state,
                     lr=lr, beta1=b1, beta2=b2, weight_decay=wd,
                     combined_scale=combined)
-                if fp16:
+                if skip_bad:
                     new_master = jax.tree_util.tree_map(
                         lambda new, old: jnp.where(overflow, old, new),
                         new_master, master)
@@ -2132,12 +2164,27 @@ class DeepSpeedTpuEngine:
         boundary update (reference deepspeed_light.py:723-788)."""
         self.global_steps += 1
         self._profile_window()
-        if self.config.fp16_enabled:
-            self.overflow = bool(overflow)   # host sync, boundary-only
+        if self.config.fp16_enabled or self._nan_sentinel:
+            # host sync, boundary-only.  With the resilience NaN sentinel
+            # the bf16/fp32 paths honour the same skip contract as fp16:
+            # overflow => untouched master/moments, no scheduler step.
+            self.overflow = bool(overflow)
         else:
             self.overflow = False
         if self.overflow:
             self.skipped_steps += 1
+            if self._nan_sentinel and not self.config.fp16_enabled:
+                # under fp16 an overflow is routine loss-scale FSM
+                # calibration (already counted in skipped_steps and logged
+                # by the scaler) — nan_skips tracks only skips the
+                # SENTINEL caused, or the observability signal drowns in
+                # scale-search noise
+                from deepspeed_tpu.resilience import COUNTERS
+                COUNTERS.nan_skips += 1
+                logger.warning(
+                    "resilience: non-finite gradients at global step %d — "
+                    "optimizer boundary skipped (nan_sentinel)",
+                    self.global_steps)
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
 
@@ -2149,6 +2196,13 @@ class DeepSpeedTpuEngine:
             self.summary_writer.add_scalar(
                 "Train/Samples/lr", float(lr_val),
                 getattr(self, "sample_count", self.global_steps))
+            # degradation the resilience layer absorbed must stay
+            # observable, not silent (docs/resilience.md "Observability")
+            from deepspeed_tpu.resilience import COUNTERS
+            for name, val in COUNTERS.as_dict().items():
+                self.summary_writer.add_scalar(
+                    f"Train/Resilience/{name}", val,
+                    getattr(self, "sample_count", self.global_steps))
 
     def _current_hypers(self):
         """Live hyperparameters from the facade groups as ONE stacked
@@ -2193,18 +2247,25 @@ class DeepSpeedTpuEngine:
             if self._step_fn is None:
                 self._step_fn = self._build_step()
             master = self.master_flat if self.zero_flat else self.master
-            (self.params, new_master, self.opt_state, self.loss_scale_state,
-             overflow, self._last_grad_norm) = self._step_fn(
-                master, self.opt_state, self._acc, self.loss_scale_state,
-                self._current_hypers(), self._zero_norm_w,
-                self._zero_gid_flat)
-            if self.zero_flat:
-                self.master_flat = new_master
-            else:
-                self.master = new_master
-            self._acc = None
-            self._post_boundary_bookkeeping(overflow)
-            self.tput_timer.stop(sync_on=self.params)
+            # armed through the boundary's host sync (the overflow read in
+            # bookkeeping): a hung boundary collective surfaces there, not
+            # at the async dispatch
+            with self._armed("optimizer boundary step"):
+                from deepspeed_tpu.resilience import chaos as _chaos
+                _chaos.maybe_stall(self.global_steps)
+                (self.params, new_master, self.opt_state,
+                 self.loss_scale_state, overflow,
+                 self._last_grad_norm) = self._step_fn(
+                    master, self.opt_state, self._acc, self.loss_scale_state,
+                    self._current_hypers(), self._zero_norm_w,
+                    self._zero_gid_flat)
+                if self.zero_flat:
+                    self.master_flat = new_master
+                else:
+                    self.master = new_master
+                self._acc = None
+                self._post_boundary_bookkeeping(overflow)
+                self.tput_timer.stop(sync_on=self.params)
 
         self.micro_steps += 1
         if wcb:
@@ -2338,18 +2399,24 @@ class DeepSpeedTpuEngine:
             "train_batch", key,
             lambda: graph_lint.analyze_engine_train_batch(self, batch))
         master = self.master_flat if self.zero_flat else self.master
-        (self.params, new_master, self.opt_state, self.loss_scale_state,
-         overflow, self._last_grad_norm, loss) = self._train_batch_fn(
-            self.params, master, self.opt_state, self.loss_scale_state,
-            self._current_hypers(), self._zero_norm_w,
-            self._zero_gid_flat, batch)
-        if self.zero_flat:
-            self.master_flat = new_master
-        else:
-            self.master = new_master
-        self.micro_steps += gas
-        self._post_boundary_bookkeeping(overflow)
-        self.tput_timer.stop(sync_on=loss)
+        # armed through the boundary's host sync (see step()): a hung
+        # collective inside the fused program surfaces at the overflow
+        # read / loss sync, not at the async dispatch
+        with self._armed("train_batch"):
+            from deepspeed_tpu.resilience import chaos as _chaos
+            _chaos.maybe_stall(self.global_steps)
+            (self.params, new_master, self.opt_state, self.loss_scale_state,
+             overflow, self._last_grad_norm, loss) = self._train_batch_fn(
+                self.params, master, self.opt_state, self.loss_scale_state,
+                self._current_hypers(), self._zero_norm_w,
+                self._zero_gid_flat, batch)
+            if self.zero_flat:
+                self.master_flat = new_master
+            else:
+                self.master = new_master
+            self.micro_steps += gas
+            self._post_boundary_bookkeeping(overflow)
+            self.tput_timer.stop(sync_on=loss)
         return loss
 
     # ------------------------------------------------------------- reporting
@@ -2377,9 +2444,10 @@ class DeepSpeedTpuEngine:
         # the save stall is not training throughput: keep it out of the
         # next report window (timer.py window accounting)
         self.tput_timer.discard_window()
-        return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
-                                        client_state=client_state,
-                                        async_save=async_save)
+        with self._armed("save_checkpoint"):
+            return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
+                                            client_state=client_state,
+                                            async_save=async_save)
 
     def checkpoint_wait(self):
         """Block until every queued async checkpoint write is on disk;
@@ -2393,10 +2461,11 @@ class DeepSpeedTpuEngine:
         client_state)."""
         self._force_live_pendings()  # deferred forwards saw the old params
         from deepspeed_tpu import checkpoint as ckpt_mod
-        path, client = ckpt_mod.load_checkpoint(
-            self, load_dir, tag=tag,
-            load_optimizer_states=load_optimizer_states,
-            load_lr_scheduler_states=load_lr_scheduler_states)
+        with self._armed("load_checkpoint"):
+            path, client = ckpt_mod.load_checkpoint(
+                self, load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states)
         return path, client
 
     # ------------------------------------------------- optimizer state (ckpt)

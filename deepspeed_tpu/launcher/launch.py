@@ -14,6 +14,13 @@ child (consumed by ``parallel.topology.init_distributed``):
 
 ``--local_rank`` is still appended to the child args for reference-CLI
 parity.
+
+Resilience: ``--max_restarts N`` relaunches this node's processes (with
+jittered exponential backoff) when they exit with a restartable code — the
+``resilience`` exit-code contract (43 = preemption drain after an emergency
+checkpoint, 44 = watchdog abort; docs/resilience.md).  The relaunched
+processes auto-resume via ``resilience.run_resumable``'s newest-valid-
+checkpoint discovery.
 """
 
 from __future__ import annotations
@@ -21,12 +28,18 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import random
 import subprocess
 import sys
+import time
 
 from deepspeed_tpu.launcher.run import decode_world_info
+from deepspeed_tpu.resilience import RESTARTABLE_EXIT_CODES
 
 logger = logging.getLogger(__name__)
+
+#: backoff ceiling between restart attempts
+RESTART_BACKOFF_CAP_S = 60.0
 
 
 def parse_args(args=None):
@@ -38,9 +51,25 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--world_info", type=str, required=True,
                         help="base64 JSON of host → slot list")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="Relaunch budget after restartable exits "
+                             f"(codes {RESTARTABLE_EXIT_CODES}: preemption "
+                             "drain / watchdog abort)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="Base seconds of the jittered exponential "
+                             "restart backoff")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
+
+
+def restart_delay_s(attempt: int, base: float,
+                    cap: float = RESTART_BACKOFF_CAP_S,
+                    rand=random.random) -> float:
+    """Jittered exponential backoff: ``min(cap, base * 2**(attempt-1)) *
+    uniform(0.5, 1.5)`` — jitter so a pod's nodes do not re-stampede the
+    coordinator in lockstep (attempt is 1-based)."""
+    return min(cap, base * (2.0 ** max(0, attempt - 1))) * (0.5 + rand())
 
 
 def global_rank_mapping(world_info):
@@ -54,17 +83,7 @@ def global_rank_mapping(world_info):
     return mapping
 
 
-def main(args=None):
-    args = parse_args(args)
-    world_info = decode_world_info(args.world_info)
-    assert len(world_info) > 0, "empty world info"
-
-    hosts = list(world_info.keys())
-    node_host = hosts[args.node_rank]
-    mapping = global_rank_mapping(world_info)
-    local_ranks = mapping[node_host]
-    world_size = sum(len(v) for v in mapping.values())
-
+def _spawn_procs(args, local_ranks, world_size, node_host):
     procs = []
     for local_rank, global_rank in enumerate(local_ranks):
         env = os.environ.copy()
@@ -82,12 +101,47 @@ def main(args=None):
                + [f"--local_rank={local_rank}"])
         logger.info("node %s rank %d: %s", node_host, global_rank, cmd)
         procs.append(subprocess.Popen(cmd, env=env))
+    return procs
 
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    return rc
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    assert len(world_info) > 0, "empty world info"
+
+    hosts = list(world_info.keys())
+    node_host = hosts[args.node_rank]
+    mapping = global_rank_mapping(world_info)
+    local_ranks = mapping[node_host]
+    world_size = sum(len(v) for v in mapping.values())
+
+    attempt = 0
+    while True:
+        procs = _spawn_procs(args, local_ranks, world_size, node_host)
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        if rc == 0:
+            return 0
+        codes = sorted({p.returncode for p in procs})
+        # restart only when EVERY failure is a restartable drain/abort —
+        # a rank that crashed with a real error (code 1, segfault) would
+        # crash again; burning the budget on it helps nobody
+        restartable = all(c in RESTARTABLE_EXIT_CODES or c == 0
+                          for c in codes)
+        if not restartable or attempt >= args.max_restarts:
+            if restartable and args.max_restarts > 0:
+                logger.error(
+                    "restart budget exhausted (%d) with exit codes %s",
+                    args.max_restarts, codes)
+            return rc
+        attempt += 1
+        delay = restart_delay_s(attempt, args.restart_backoff)
+        logger.warning(
+            "restartable exit codes %s: relaunching (attempt %d/%d) "
+            "after %.1fs backoff", codes, attempt, args.max_restarts, delay)
+        time.sleep(delay)
 
 
 if __name__ == "__main__":

@@ -62,6 +62,13 @@ def parse_args(args=None):
     parser.add_argument("--launcher", type=str, default="pdsh",
                         choices=("pdsh", "ssh", "local"),
                         help="Fan-out backend")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="Per-node relaunch budget after restartable "
+                             "exits (preemption drain / watchdog abort; "
+                             "docs/resilience.md)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="Base seconds of the jittered exponential "
+                             "restart backoff")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat a single-node pool as multi-node (ssh)")
     parser.add_argument("user_script", type=str,
@@ -252,6 +259,9 @@ def main(args=None):
         f"--master_addr={master_addr}",
         f"--master_port={args.master_port}",
     ]
+    if args.max_restarts:
+        launch_cmd += [f"--max_restarts={args.max_restarts}",
+                       f"--restart_backoff={args.restart_backoff}"]
 
     if not multi_node:
         cmd = launch_cmd + ["--node_rank=0", args.user_script] + args.user_args

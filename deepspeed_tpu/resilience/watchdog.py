@@ -1,0 +1,155 @@
+"""Hang watchdog: a heartbeat thread armed around blocking calls.
+
+A hung collective on a pod slice is worse than a crash: the job burns its
+reservation doing nothing and nobody is told.  The watchdog is armed around
+each blocking engine call (step / train_batch / backward / checkpoint IO —
+engine._armed) and, past the configured deadline:
+
+1. dumps EVERY thread's stack (``sys._current_frames``) plus the last N
+   armed-operation timings to the log (the dump names the stuck frame —
+   pinned by the chaos suite), and
+2. optionally aborts the process with ``WATCHDOG_EXIT_CODE`` so the
+   launcher's ``--max_restarts`` path can take over
+   (``resilience.watchdog_abort``).
+
+Operations that complete but consume more than ``near_miss_frac`` of the
+deadline increment ``COUNTERS.watchdog_near_misses`` — the observable
+early-warning that a deadline is about to start firing.
+
+NOTE: importable without jax (the launcher parent imports the exit-code
+contract).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from contextlib import contextmanager
+
+from deepspeed_tpu.resilience.counters import COUNTERS
+
+logger = logging.getLogger(__name__)
+
+#: process aborted by the hang watchdog after dumping stacks: the launcher
+#: should relaunch (docs/resilience.md "Exit codes")
+WATCHDOG_EXIT_CODE = 44
+
+
+def format_all_stacks() -> str:
+    """Every live thread's current stack, rendered with frame names."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        parts.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        parts.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(parts)
+
+
+class Watchdog:
+    """Deadline monitor for armed operations.
+
+    One background monitor thread (daemon, started on first arm) polls the
+    armed deadline; arming is two clock reads and a field write, cheap
+    enough for the per-step hot path.  Armed regions do not nest — the
+    engine's blocking calls are sequential.
+    """
+
+    def __init__(self, timeout_s: float, abort: bool = False,
+                 near_miss_frac: float = 0.8, history: int = 32,
+                 poll_s: float = None):
+        self.timeout_s = float(timeout_s)
+        self.abort = bool(abort)
+        self.near_miss_frac = float(near_miss_frac)
+        self.poll_s = (poll_s if poll_s is not None
+                       else max(0.02, min(1.0, self.timeout_s / 10.0)))
+        self.timings = deque(maxlen=int(history))   # (label, seconds)
+        self.fired = False          # any fire over the watchdog's lifetime
+        self.last_dump = None
+        self.fire_event = threading.Event()
+        self._lock = threading.Lock()
+        self._armed_label = None
+        self._armed_at = None
+        self._fired_this_arm = False
+        self._thread = None
+
+    # ------------------------------------------------------------- arming
+    @contextmanager
+    def armed(self, label: str):
+        self._arm(label)
+        try:
+            yield self
+        finally:
+            self._disarm()
+
+    def _arm(self, label: str) -> None:
+        self._ensure_thread()
+        with self._lock:
+            if self._armed_label is not None:
+                raise RuntimeError(
+                    f"watchdog already armed for {self._armed_label!r}; "
+                    f"armed regions do not nest (attempted {label!r})")
+            self._armed_label = label
+            self._armed_at = time.monotonic()
+            self._fired_this_arm = False
+
+    def _disarm(self) -> None:
+        with self._lock:
+            label, at = self._armed_label, self._armed_at
+            fired = self._fired_this_arm
+            self._armed_label = None
+            self._armed_at = None
+            self._fired_this_arm = False
+        if at is None:
+            return
+        dur = time.monotonic() - at
+        self.timings.append((label, dur))
+        if not fired and dur > self.near_miss_frac * self.timeout_s:
+            COUNTERS.watchdog_near_misses += 1
+            logger.warning(
+                "watchdog near-miss: %r took %.2fs of a %.2fs deadline",
+                label, dur, self.timeout_s)
+
+    # ------------------------------------------------------------ monitor
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._monitor, daemon=True, name="dstpu-watchdog")
+            self._thread.start()
+
+    def _monitor(self) -> None:
+        while True:
+            time.sleep(self.poll_s)
+            with self._lock:
+                label, at = self._armed_label, self._armed_at
+                already = self._fired_this_arm
+                if (label is None or already
+                        or time.monotonic() - at <= self.timeout_s):
+                    continue
+                self._fired_this_arm = True
+            self._fire(label, time.monotonic() - at)
+
+    def _fire(self, label: str, elapsed: float) -> None:
+        recent = "\n".join(f"  {lbl}: {dur * 1000.0:.1f} ms"
+                           for lbl, dur in self.timings) or "  (none)"
+        dump = (f"WATCHDOG: {label!r} exceeded {self.timeout_s:.2f}s "
+                f"deadline ({elapsed:.2f}s elapsed)\n"
+                f"last {len(self.timings)} armed-operation timings:\n"
+                f"{recent}\n"
+                f"all-thread stacks:\n{format_all_stacks()}")
+        self.last_dump = dump
+        self.fired = True
+        COUNTERS.watchdog_fires += 1
+        logger.error("%s", dump)
+        self.fire_event.set()
+        if self.abort:
+            # the restart path takes over: flush the dump to stderr and
+            # exit with the contract code.  os._exit, not sys.exit — the
+            # main thread is by definition stuck and cannot unwind.
+            sys.stderr.write(dump + "\n")
+            sys.stderr.flush()
+            os._exit(WATCHDOG_EXIT_CODE)

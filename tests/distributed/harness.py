@@ -78,9 +78,19 @@ def spawn_distributed(func_name: str, world_size: int = 2,
     or missing completion sentinel.  A gloo TCP transport flake (see
     ``_GLOO_FLAKE_MARKER``) is retried (twice) on fresh ports.
     """
+    eff_env = env_extra
+    if env_extra and "DSTPU_TEST_DIR" in env_extra:
+        # hermetic per-attempt state: a retried spawn must not see
+        # checkpoints/sentinel files a previous (flaked) attempt left
+        # behind — a stale emergency checkpoint would make the chaos
+        # scenarios resume PAST their injected fault step
+        sub = os.path.join(env_extra["DSTPU_TEST_DIR"],
+                           f"attempt{_retries_left}")
+        os.makedirs(sub, exist_ok=True)
+        eff_env = {**env_extra, "DSTPU_TEST_DIR": sub}
     try:
         return _spawn_distributed_once(func_name, world_size, local_devices,
-                                       timeout, env_extra)
+                                       timeout, eff_env)
     except AssertionError as e:
         if _retries_left > 0 and _GLOO_FLAKE_MARKER in str(e):
             print(f"spawn_distributed({func_name!r}): gloo transport flake, "

@@ -70,6 +70,28 @@ def test_zero_pps_mp_checkpoint_resume_multiprocess(tmpdir):
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
 
 
+@pytest.mark.chaos
+def test_chaos_sigterm_resume_zero1_multiprocess(tmpdir):
+    """ISSUE 4 chaos proof, ZeRO-1 leg: SIGTERM rank 0 mid-run — the psum
+    agreement drains BOTH processes at the same step, the emergency
+    checkpoint lands under emergency/, and a fresh auto-resume finishes
+    BITWISE identical to the uninterrupted run (data-iterator state
+    included)."""
+    spawn_distributed("chaos_sigterm_resume_zero1", world_size=2,
+                      local_devices=2,
+                      env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
+
+
+@pytest.mark.chaos
+def test_chaos_sigterm_resume_zero3_multiprocess(tmpdir):
+    """ISSUE 4 chaos proof, ZeRO-3 leg: same drain/resume contract with
+    data-sharded parameters and the shard-native stage-3 checkpoint
+    format."""
+    spawn_distributed("chaos_sigterm_resume_zero3", world_size=2,
+                      local_devices=2,
+                      env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
+
+
 def test_zero_mp_checkpoint_roles_multiprocess(tmpdir):
     spawn_distributed("zero_mp_ckpt_roles", world_size=2, local_devices=2,
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
@@ -375,8 +397,14 @@ def _inprocess_parity_losses(mp, cfg):
 
 @pytest.mark.parametrize("label,mp,extra,tol", [
     ("mp2_dp2", 2, {}, 1e-4),
-    ("zero3_dp4", 1, {"zero_optimization": {"stage": 3},
-                      "bf16": {"enabled": True}}, 5e-3),
+    # the zero3 leg compiles the heaviest program of the tier (~50 s on
+    # the CI box); the mp2_dp2 leg keeps launcher loss parity in tier-1
+    # while the zero3 x launcher combination runs nightly (slow tier) —
+    # zero3 resume/drain coverage stays in tier-1 via the chaos and
+    # checkpoint-resume multiprocess tests
+    pytest.param("zero3_dp4", 1, {"zero_optimization": {"stage": 3},
+                                  "bf16": {"enabled": True}}, 5e-3,
+                 marks=pytest.mark.slow),
 ])
 def test_dst_loss_parity(label, mp, extra, tol, tmpdir):
     """VERDICT r4 missing #3 (reference run_func_test.py:46-122): drive a

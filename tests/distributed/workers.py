@@ -240,6 +240,128 @@ def zero_pps_mp_ckpt_resume():
     assert post == ref_losses[3:], (post, ref_losses[3:])
 
 
+# ------------------------------------------------------------ chaos tier
+# (ISSUE 4 acceptance: a 2-process CPU run SIGTERM'd mid-run auto-resumes —
+# data-iterator state included — and finishes BITWISE identical to an
+# uninterrupted run, at ZeRO stage 1 and stage 3.)
+
+from simple_model import master_bytes as _master_bytes  # noqa: E402
+
+
+def _chaos_sigterm_resume(factory, make_loader, train_step, steps,
+                          sigterm_step):
+    """Shared chaos scenario body: unbroken run → SIGTERM'd run (rank 0
+    only; the agreement collective must drain BOTH ranks) → emergency
+    checkpoint → fresh-engine auto-resume → bitwise parity."""
+    from deepspeed_tpu import resilience
+    from deepspeed_tpu.resilience import COUNTERS, PreemptionHandler, chaos
+
+    ckdir = _test_dir()
+    rank = jax.process_index()
+    COUNTERS.reset()
+
+    unbroken = resilience.run_resumable(
+        factory, train_step, steps=steps,
+        save_dir=os.path.join(ckdir, "unbroken"), data_loader=make_loader())
+    ref = _master_bytes(unbroken)
+
+    # SIGTERM ONLY rank 0: rank 1 must drain via the psum agreement, at
+    # the same step, or the job deadlocks/diverges
+    handler = PreemptionHandler(sentinel_file=os.path.join(ckdir, "unused"))
+    chaos.configure(sigterm_step=sigterm_step, sigterm_rank=0)
+    bdir = os.path.join(ckdir, "interrupted")
+    try:
+        resilience.run_resumable(factory, train_step, steps=steps,
+                                 save_dir=bdir, data_loader=make_loader(),
+                                 handler=handler)
+        raise AssertionError("expected a preemption drain")
+    except SystemExit as e:
+        assert e.code == resilience.RESUME_EXIT_CODE, e.code
+    if rank != 0:
+        # this rank never saw a signal: it drained because the agreement
+        # collective said another host did
+        assert not handler._flag
+    from deepspeed_tpu.checkpoint import find_latest_valid_tag
+    tag = find_latest_valid_tag(bdir)
+    assert tag is not None and tag.startswith("emergency/"), tag
+
+    chaos.reset()
+    handler.clear()
+    resumed = resilience.run_resumable(factory, train_step, steps=steps,
+                                       save_dir=bdir,
+                                       data_loader=make_loader(),
+                                       handler=handler)
+    assert resumed.global_steps == steps
+    assert COUNTERS.restarts == 1
+    assert _master_bytes(resumed) == ref, \
+        "auto-resumed parameters are not bitwise identical to unbroken run"
+
+
+def chaos_sigterm_resume_zero1():
+    """ZeRO-1 fp16 leg of the chaos proof (split API + DataLoader)."""
+    from deepspeed_tpu.data import ArrayDataset, DeepSpeedDataLoader
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 8)).astype(np.float16)
+    y = rng.integers(0, 8, size=(48,)).astype(np.int32)
+    dataset = ArrayDataset(x, y)
+
+    def factory():
+        engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=8),
+                                        config=dict(_ZERO_CFG))
+        return engine
+
+    def make_loader():
+        return DeepSpeedDataLoader(dataset, batch_size=8, mesh=None, seed=5)
+
+    def train_step(engine, batch):
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+
+    _chaos_sigterm_resume(factory, make_loader, train_step,
+                          steps=5, sigterm_step=3)
+
+
+def chaos_sigterm_resume_zero3():
+    """ZeRO-3 bf16 leg: parameters/masters stay data-sharded across the
+    2 processes; the emergency save uses the shard-native stage-3 format
+    and the resume must still be bitwise."""
+    from deepspeed_tpu.data import ArrayDataset, DeepSpeedDataLoader
+    from deepspeed_tpu.models import GPT2
+
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+    }
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, 64, size=(40, 16)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+    dataset = ArrayDataset(toks, labels)
+
+    def factory():
+        model = GPT2.from_size("tiny", vocab_size=64, max_seq_len=16,
+                               num_layers=2, hidden_size=32, num_heads=4)
+        engine, _, _, _ = ds.initialize(
+            model=model, config=dict(cfg),
+            model_parameters=model.init_params(jax.random.PRNGKey(3)))
+        assert engine.zero3
+        return engine
+
+    def make_loader():
+        return DeepSpeedDataLoader(dataset, batch_size=8, mesh=None, seed=11)
+
+    def train_step(engine, batch):
+        engine.train_batch(batch)
+
+    _chaos_sigterm_resume(factory, make_loader, train_step,
+                          steps=4, sigterm_step=2)
+
+
 # ---------------------------------------------------------------- scenario 3
 
 class TinyTP:

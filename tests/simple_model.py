@@ -75,3 +75,17 @@ def args_from_dict(tmpdir, config_dict):
     args.local_rank = 0
     args.deepspeed_mpi = False
     return args
+
+
+def master_bytes(engine):
+    """Bitwise snapshot of this process's addressable fp32 master shards
+    (flat ZeRO layout or the stage-3 per-leaf tree) — the resume-parity
+    assertion of the resilience/chaos suites (single- AND multi-process)."""
+    import jax
+    import numpy as np
+    if engine.zero_flat:
+        leaves = [engine.master_flat]
+    else:
+        leaves = jax.tree_util.tree_leaves(engine.master)
+    return b"".join(np.asarray(s.data).tobytes()
+                    for leaf in leaves for s in leaf.addressable_shards)
