@@ -25,6 +25,9 @@ schedule sweep, BENCH_ATTN_SWEEP=1 for the attention-kernel sweep,
 BENCH_HEAD=1 for the MLM-head sparse-vs-dense microbench (CPU-safe),
 BENCH_OVERLAP=1 for the ZeRO boundary comm/compute-overlap microbench
 (CPU-safe: parity + bucket-count evidence; see bench_overlap.json),
+BENCH_RESUME=1 for the time-to-first-step-after-relaunch bench (serial vs
+parallel streaming restore + cold vs warm persistent compile cache;
+CPU-safe; see bench_resume.json),
 BENCH_DEVICE_TIMEOUT (default 600 s; <= 0 disables) to fail crisply
 instead of hanging when the device tunnel is wedged.
 
@@ -1113,6 +1116,115 @@ def run_ckpt_bench(tmpdir=None):
     return 0
 
 
+def run_resume_bench(tmpdir=None):
+    """End-to-end time-to-first-step after a relaunch (BENCH_RESUME=1):
+    the two halves of fast resume, measured separately and summed.
+
+    Restore: one engine saves a checkpoint, then a fresh engine (different
+    init seed — nothing to reuse) restores it twice, first through the
+    serial fallback (``restore_threads=1``) and then through the parallel
+    streaming pipeline (``restore_threads=0`` auto) — same files, bitwise
+    the same state, different wall-clock.  Compile: the persistent
+    compilation cache is pointed at a fresh directory, so the FIRST
+    train_batch pays real XLA compilation (cold, counted as cache misses)
+    and the restored engine's first train_batch — after
+    ``jax.clear_caches()`` drops the in-memory executables, exactly like a
+    relaunched process — deserializes from the cache instead (warm,
+    counted as hits).  One JSON line → bench_resume.json."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertForPreTraining
+    from deepspeed_tpu.resilience.counters import COUNTERS
+
+    on_tpu = jax.default_backend() == "tpu"
+    size = os.environ.get("BENCH_SIZE", "large" if on_tpu else "base")
+    root = tmpdir or tempfile.mkdtemp(prefix="dstpu_resume_bench_")
+    cache_dir = os.path.join(root, "compile_cache")
+    ckpt_dir = os.path.join(root, "ckpt")
+
+    def build(seed):
+        model = BertForPreTraining.from_size(size, max_seq_len=128)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config={"train_batch_size": 8, "steps_per_print": 10 ** 9,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": True},
+                    "compile_cache": {"dir": cache_dir},
+                    "checkpoint": {"restore_threads": 1}},
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(seed)))
+        return model, engine
+
+    model, engine = build(0)
+    n_params = _count_params(engine.params)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.config.vocab_size, size=(8, 128))
+    positions = np.stack([rng.choice(128, size=20, replace=False)
+                          for _ in range(8)]).astype(np.int32)
+    batch = (ids.astype(np.int32), np.ones((8, 128), np.int32),
+             np.zeros((8, 128), np.int32), positions,
+             np.take_along_axis(ids, positions, axis=1).astype(np.int32),
+             np.ones((8, 20), np.float32))
+
+    rows = {}
+    h0, m0 = COUNTERS.compile_cache_hits, COUNTERS.compile_cache_misses
+    t0 = time.perf_counter()
+    float(engine.train_batch(batch))
+    rows["compile_cold_s"] = round(time.perf_counter() - t0, 3)
+    rows["cold_cache_misses"] = COUNTERS.compile_cache_misses - m0
+    engine.save_checkpoint(ckpt_dir, tag="resume")
+
+    # fresh engine, serial restore (the pre-PR-5 read path)
+    _, e_serial = build(1)
+    t0 = time.perf_counter()
+    e_serial.load_checkpoint(ckpt_dir, tag="resume")
+    rows["restore_serial_s"] = round(time.perf_counter() - t0, 3)
+
+    # fresh engine, parallel streaming restore (reader pool, auto width)
+    _, e_par = build(2)
+    e_par.config.checkpoint_restore_threads = 0
+    t0 = time.perf_counter()
+    e_par.load_checkpoint(ckpt_dir, tag="resume")
+    rows["restore_parallel_s"] = round(time.perf_counter() - t0, 3)
+
+    # a relaunched process has no in-memory executables — drop ours so the
+    # restored engine's first step goes to the persistent cache
+    jax.clear_caches()
+    h1 = COUNTERS.compile_cache_hits
+    t0 = time.perf_counter()
+    loss = float(e_par.train_batch(batch))
+    rows["compile_warm_s"] = round(time.perf_counter() - t0, 3)
+    rows["warm_cache_hits"] = COUNTERS.compile_cache_hits - h1
+    if rows["warm_cache_hits"] <= 0:
+        raise RuntimeError(
+            "BENCH_RESUME: the restored engine's first step did not hit "
+            "the persistent compilation cache (hits stayed at "
+            f"{COUNTERS.compile_cache_hits}) — the relaunch would pay a "
+            "full recompile")
+
+    rows["time_to_first_step_cold_s"] = round(
+        rows["restore_serial_s"] + rows["compile_cold_s"], 3)
+    rows["time_to_first_step_warm_s"] = round(
+        rows["restore_parallel_s"] + rows["compile_warm_s"], 3)
+    if not tmpdir:
+        shutil.rmtree(root, ignore_errors=True)
+
+    _emit({"metric": "resume_time_to_first_step",
+           "value": rows["time_to_first_step_warm_s"],
+           "unit": "s (parallel restore + warm compile cache)",
+           "n_params": n_params, "platform": jax.default_backend(),
+           "loss_after_resume": round(loss, 6),
+           "note": ("cold = serial restore + full XLA compile (a relaunch "
+                    "before PR 5); warm = parallel streaming restore + "
+                    "persistent-cache deserialize.  warm_cache_hits > 0 "
+                    "is the proof the restarted step skipped recompilation"),
+           **rows})
+    return 0
+
+
 def main():
     # A wedged device tunnel makes the first jax.devices() hang FOREVER
     # (observed failure mode: the axon relay listener disappears and every
@@ -1154,6 +1266,8 @@ def main():
             steps=int(os.environ.get("BENCH_STEPS", "4")))
     if os.environ.get("BENCH_CKPT", "0") == "1":
         return run_ckpt_bench()
+    if os.environ.get("BENCH_RESUME", "0") == "1":
+        return run_resume_bench()
     if os.environ.get("BENCH_MFU_BREAKDOWN", "0") == "1":
         return run_mfu_breakdown()
     if os.environ.get("BENCH_OPT", "0") == "1":

@@ -175,12 +175,21 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress):
     cfg.pop("graph_lint", None)
     family = _infer_family(path, family)
     model, make_batch = _build_model(family, seq_len, config_path=path)
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model, config=cfg,
-        model_parameters=model.init_params(jax.random.PRNGKey(0)))
-    batch = make_batch(engine.train_micro_batch_size_per_gpu()
-                       * engine.dp_world_size)
-    rep = analysis.analyze_engine(engine, batch, train=True)
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg,
+            model_parameters=model.init_params(jax.random.PRNGKey(0)))
+        batch = make_batch(engine.train_micro_batch_size_per_gpu()
+                           * engine.dp_world_size)
+        rep = analysis.analyze_engine(engine, batch, train=True)
+    finally:
+        # engine build enables any configured persistent compile cache
+        # PROCESS-WIDE (and exports the env fallback for relaunches) —
+        # turn it back off so one gated config's cache dir cannot leak
+        # into the next config's build in this multi-config CLI
+        from deepspeed_tpu.utils import compile_cache
+        if compile_cache.enabled_dir() is not None:
+            compile_cache.disable()
     rep.subject = f"{path} (model={family})"
     return rep.filtered(suppress)
 

@@ -459,10 +459,13 @@ def _collect_shard_states(tree, specs, axes, mesh=None, replace=None,
     return trees, owned
 
 
-def _combine_shard_states(local_trees, specs, axes):
-    """Inverse of ``_collect_shard_states`` on the host: one global np tree.
+def _combine_shard_states(local_trees, specs, axes, lazy=False):
+    """Inverse of ``_collect_shard_states`` on the host: one global np tree
+    (``lazy=True``: deferred :class:`LazyParts` leaves for the streaming
+    restore — only callers that feed ``_place_trees`` may ask for it).
     Combines the innermost axis first (rank = outer * inner_size + inner)."""
-    return zero_mod.combine_composite_trees(local_trees, specs, axes)
+    return zero_mod.combine_composite_trees(local_trees, specs, axes,
+                                            lazy=lazy)
 
 
 def _state_axes(pp_size: int, mp_size: int):
@@ -1048,6 +1051,212 @@ def find_latest_valid_tag(load_dir: str, exclude=()) -> Optional[str]:
     return None if best is None else best[1]
 
 
+# ------------------------------------------- parallel streaming restore
+#
+# PR 4 made auto-resume the normal operating mode, which put RESTORE on the
+# critical path of every restart — and the serial read path (leaf-at-a-time
+# np.concatenate over memmap views, then per-leaf device placement) was the
+# slow side: CKPT_BENCH.md measured 621 s restore vs 45 s async-save stall
+# at 1.5B.  The pipeline below mirrors the async writer in the other
+# direction: a reader pool streams chunk records from the container (ZeRO-3
+# shard records read concurrently per shard file), each leaf is assembled
+# as its chunks land, and device placement (`_put_global`) of leaf i
+# overlaps the reads of every later leaf.  Readers use positioned file
+# reads (`readinto`, which releases the GIL during the syscall) instead of
+# memmap page faults (which hold it), each read is composed with
+# ``io_retry``, and in-flight read results are bounded by
+# ``restore_readahead_mb`` — peak host RAM is one readahead window plus the
+# leaf being placed, NOT the whole state tree.  ``restore_threads <= 1``
+# executes the same plan inline (the serial fallback); both paths run the
+# identical per-leaf assembly, so they are bitwise-interchangeable
+# (pinned by tests/test_checkpoint_restore.py).
+
+LazyParts = zero_mod.LazyParts
+
+
+class CheckpointReadError(RuntimeError):
+    """A restore reader failed (corrupt/truncated chunk, or storage errors
+    that exhausted the per-reader ``io_retries`` budget).  Named — a dead
+    reader must surface as a prompt exception on the restoring thread, not
+    as a hang of the consumer."""
+
+
+class _RestorePlan:
+    """Resolved restore-path knobs for one load: reader-pool width,
+    readahead window, per-reader retry budget."""
+
+    def __init__(self, threads: int = 1, readahead_mb: float = 256.0,
+                 io_retries: int = 3):
+        self.threads = int(threads)
+        self.readahead_bytes = max(1, int(float(readahead_mb) * 2 ** 20))
+        self.io_retries = int(io_retries)
+
+    @classmethod
+    def auto_threads(cls) -> int:
+        # reads are memcpy-bound once the page cache is warm and IO-bound
+        # when cold; a couple of readers per core covers both without
+        # oversubscribing small hosts
+        return max(2, min(8, 2 * (os.cpu_count() or 1)))
+
+    @classmethod
+    def from_engine(cls, engine) -> "_RestorePlan":
+        cfg = getattr(engine, "config", None)
+        threads = int(getattr(cfg, "checkpoint_restore_threads", 0))
+        if threads == 0:
+            threads = cls.auto_threads()
+        return cls(
+            threads=threads,
+            readahead_mb=float(getattr(cfg, "checkpoint_restore_readahead_mb",
+                                       256.0)),
+            io_retries=int(getattr(cfg, "resilience_io_retries", 3)))
+
+
+def _read_part(part):
+    """Materialize one chunk source as a host array.
+
+    np.memmap chunks are fetched with a positioned ``readinto`` — unlike
+    ``np.asarray(memmap)``, whose page faults hold the GIL for the whole
+    IO wait, ``readinto`` releases it, so pool readers actually overlap.
+    A short read names the truncation instead of handing back garbage."""
+    _chaos.read_point("ckpt_read")
+    if isinstance(part, np.memmap) and getattr(part, "filename", None):
+        out = np.empty(part.shape, part.dtype)
+        if out.nbytes:
+            with open(part.filename, "rb") as f:
+                f.seek(int(part.offset))
+                got = f.readinto(memoryview(
+                    out.reshape(-1).view(np.uint8)))
+            if got != out.nbytes:
+                raise CheckpointReadError(
+                    f"truncated checkpoint chunk in {part.filename!r}: "
+                    f"wanted {out.nbytes} bytes at offset {part.offset}, "
+                    f"file ended after {got}")
+        return out
+    if isinstance(part, np.ndarray):
+        return np.asarray(part)
+    return part
+
+
+def _leaf_plan(leaf):
+    """(parts, assemble) of one restore leaf — LazyParts pass through,
+    anything else is a single already-resolved source."""
+    if isinstance(leaf, LazyParts):
+        return leaf.parts, leaf.assemble
+    return [leaf], (lambda arrs: arrs[0])
+
+
+def _part_desc(part) -> str:
+    fn = getattr(part, "filename", None)
+    if fn:
+        return f"{fn}@{getattr(part, 'offset', '?')}"
+    return type(part).__name__
+
+
+def _stream_leaves(leaves, plan: _RestorePlan):
+    """Yield host arrays for ``leaves`` in order, reads pipelined.
+
+    Every leaf expands into its chunk parts; with ``plan.threads > 1`` a
+    reader pool fetches parts concurrently (submission runs ahead of
+    consumption until ``readahead_bytes`` of results are in flight, so
+    the window — not the pool — bounds host RAM), and the consumer
+    assembles each leaf as its chunks land.  The serial fallback
+    (``threads <= 1``) executes the same plan inline: identical reads,
+    identical assembly, bitwise-identical leaves."""
+    from deepspeed_tpu.resilience.retry import io_retry
+
+    def read(part):
+        # exhausted-retry storage errors surface as the SAME named error on
+        # both the serial and pooled paths (tests pin the contract)
+        try:
+            return io_retry(lambda: _read_part(part),
+                            retries=plan.io_retries,
+                            what=f"checkpoint chunk read ({_part_desc(part)})")
+        except CheckpointReadError:
+            raise
+        except Exception as e:
+            raise CheckpointReadError(
+                f"checkpoint restore reader failed on "
+                f"{_part_desc(part)}: {e}") from e
+
+    plans = [_leaf_plan(x) for x in leaves]
+    if plan.threads <= 1:
+        for parts, assemble in plans:
+            yield assemble([read(p) for p in parts])
+        return
+
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+    flat = [(p, int(getattr(p, "nbytes", 0) or 0))
+            for parts, _ in plans for p in parts]
+    ex = ThreadPoolExecutor(max_workers=plan.threads,
+                            thread_name_prefix="dstpu-ckpt-reader")
+    pending = collections.deque()   # (future, nbytes, part) in flat order
+    state = {"si": 0, "inflight": 0}
+
+    def pump():
+        # keep at least one read in flight and the window full; consuming
+        # a result frees window bytes, so the pool always drains forward
+        # (no reader ever waits on the consumer — deadlock-free)
+        while state["si"] < len(flat) and (
+                not pending or state["inflight"] < plan.readahead_bytes):
+            part, nb = flat[state["si"]]
+            pending.append((ex.submit(read, part), nb, part))
+            state["si"] += 1
+            state["inflight"] += nb
+
+    try:
+        for parts, assemble in plans:
+            arrs = []
+            for _ in parts:
+                pump()
+                fut, nb, part = pending.popleft()
+                try:
+                    arrs.append(fut.result())
+                except CheckpointReadError:
+                    raise
+                except Exception as e:
+                    raise CheckpointReadError(
+                        f"checkpoint restore reader failed on "
+                        f"{_part_desc(part)}: {e}") from e
+                state["inflight"] -= nb
+                pump()
+            yield assemble(arrs)
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
+
+
+def _place_trees(pairs, plan: _RestorePlan):
+    """Restore ``pairs`` of (engine tree, loaded host/lazy tree): streams
+    every leaf through ONE pipelined read plan (so placing the module
+    overlaps reading the masters) and places each with ``_put_global``.
+    Returns the placed trees in ``pairs`` order; ``None`` new-trees map to
+    ``None`` (absent moment trees)."""
+    olds, news, treedefs, counts = [], [], [], []
+    for old, new in pairs:
+        if old is None or new is None:
+            treedefs.append(None)
+            counts.append(0)
+            continue
+        o, td = jax.tree_util.tree_flatten(old)
+        olds.extend(o)
+        news.extend(td.flatten_up_to(new))
+        treedefs.append(td)
+        counts.append(len(o))
+    stream = _stream_leaves(news, plan)
+    try:
+        placed = [_put_global(o, h) for o, h in zip(olds, stream)]
+    finally:
+        stream.close()      # releases the reader pool on error paths too
+    out, i = [], 0
+    for td, n in zip(treedefs, counts):
+        if td is None:
+            out.append(None)
+        else:
+            out.append(td.unflatten(placed[i:i + n]))
+            i += n
+    return out
+
+
 # ------------------------------------------------------------------ loading
 
 def load_module_tree(load_dir: str, tag: Optional[str] = None, specs=None):
@@ -1077,14 +1286,17 @@ def load_module_tree(load_dir: str, tag: Optional[str] = None, specs=None):
                                  _state_axes(saved_pp, saved_mp))
 
 
-def _zero3_rehydrate(load_dir: str, tag: str, states):
+def _zero3_rehydrate(load_dir: str, tag: str, states, lazy: bool = False):
     """Replace stage-3 partition markers in freshly read model states with
     full-along-data leaves reassembled from the per-(row, dp) shard files
     (concat along the recorded dim).  After this the states look exactly
     like stage-<=2 files, so every downstream path (cross-row combine,
     cross-topology/-stage restore, raw-weights reads) works unchanged.
-    Reassembly materialises one full leaf at a time on the host; the shard
-    chunks themselves are memmap views."""
+    With ``lazy=False`` reassembly materialises one full leaf at a time on
+    the host (the shard chunks themselves are memmap views); ``lazy=True``
+    returns :class:`LazyParts` leaves instead — same chunks, same concat,
+    deferred so the restore reader pool can fetch the per-dp shard records
+    of one leaf concurrently (``_stream_leaves``)."""
     if not states or not states[0].get("zero3_native"):
         return states
     for row, state in enumerate(states):
@@ -1122,9 +1334,11 @@ def _zero3_rehydrate(load_dir: str, tag: str, states):
                         r = leaves[jax.tree_util.keystr(path)]
                     return r
 
+                chunks = [rec(d)[field] for d in range(dp)]
+                if lazy:
+                    return LazyParts.concat(chunks, dim)
                 return np.concatenate(
-                    [np.asarray(rec(d)[field]) for d in range(dp)],
-                    axis=dim)
+                    [np.asarray(c) for c in chunks], axis=dim)
 
             return jax.tree_util.tree_map_with_path(
                 one, tree, is_leaf=_z3_marker)
@@ -1140,10 +1354,11 @@ def _zero3_rehydrate(load_dir: str, tag: str, states):
     return states
 
 
-def _read_model_states(load_dir: str, tag: Optional[str]):
+def _read_model_states(load_dir: str, tag: Optional[str], lazy: bool = False):
     """Shared tag-resolution + model-state file reads (load_checkpoint and
     load_module_tree).  Returns ``(tag, states, saved_mp, saved_pp)`` or
-    None when no checkpoint exists."""
+    None when no checkpoint exists.  ``lazy`` defers the stage-3 shard
+    reassembly to :class:`LazyParts` leaves (the streaming restore)."""
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         tag = None
@@ -1177,7 +1392,7 @@ def _read_model_states(load_dir: str, tag: Optional[str]):
         _load_obj(model_file(load_dir, tag, r % saved_mp, r // saved_mp,
                              saved_pp))
         for r in range(1, saved_pp * saved_mp)]
-    states = _zero3_rehydrate(load_dir, tag, states)
+    states = _zero3_rehydrate(load_dir, tag, states, lazy=lazy)
     return tag, states, saved_mp, saved_pp
 
 
@@ -1201,7 +1416,10 @@ def _put_global(old, new):
             f"engine expects {tuple(old.shape)}")
     sharding = old.sharding
     if sharding.is_fully_addressable:
-        return jax.device_put(jnp.asarray(arr), sharding)
+        # device_put straight from the host buffer: routing through
+        # jnp.asarray first would stage an extra full-leaf copy on the
+        # restore critical path
+        return jax.device_put(arr, sharding)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])
 
@@ -1235,9 +1453,15 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True):
     """Engine-level load (reference load_checkpoint :974-1046).  Returns
-    ``(path, client_state)``; (None, None) when nothing is found."""
+    ``(path, client_state)``; (None, None) when nothing is found.
+
+    The heavy reads run through the streaming restore pipeline (see the
+    "parallel streaming restore" section above): every state tree's leaves
+    enter ONE read plan, so the reader pool fetches the masters' chunks
+    while the module weights are already being placed on devices."""
     ASYNC_SAVER.wait()   # never read a tag whose writes are still queued
-    read = _read_model_states(load_dir, tag)
+    plan = _RestorePlan.from_engine(engine)
+    read = _read_model_states(load_dir, tag, lazy=True)
     if read is None:
         return None, None
     tag, states, saved_mp, saved_pp = read
@@ -1247,10 +1471,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     # local slices and re-sharded for the CURRENT mesh — reference :995-1004
     # (which requires the same MP degree; the reassembly lifts that)
     saved_axes = _state_axes(saved_pp, saved_mp)
+    # lazy: cross-MP/PP-shard concatenations stay deferred so the reader
+    # pool fetches each shard's chunks concurrently (_place_trees streams
+    # every leaf below)
     module = _combine_shard_states([s["module"] for s in states],
-                                   engine._param_specs, saved_axes)
-    engine.params = jax.tree_util.tree_map(_put_global, engine.params,
-                                           module)
+                                   engine._param_specs, saved_axes,
+                                   lazy=True)
 
     # counters — reference :1014-1017
     engine.global_steps = int(state["global_steps"])
@@ -1274,16 +1500,16 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     restored_masters = False
     saved_stage = state.get("zero_stage",
                             1 if state.get("zero_enabled") else 0)
+    zero_flat = getattr(engine, "zero_flat", engine.zero_enabled)
+    opt_pairs = []
     if load_optimizer_states:
-        if getattr(engine, "zero_flat", engine.zero_enabled):
+        if zero_flat:
             if saved_stage == 3:
                 raise ValueError(
                     "checkpoint was saved at ZeRO stage 3 (optimizer state "
                     "inline, per-leaf) but this engine runs the stage-1/2 "
                     "flat layout — set zero_optimization.stage=3 (or 0) to "
                     "restore it, or pass load_optimizer_states=False")
-            _load_zero_checkpoint(engine, load_dir, tag)
-            restored_masters = True
         elif saved_stage in (1, 2):
             raise ValueError(
                 "checkpoint was saved with zero_optimization stage 1/2 "
@@ -1294,24 +1520,39 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         elif state.get("optimizer") is not None:
             master = _combine_shard_states(
                 [s["optimizer"]["master"] for s in states],
-                engine._param_specs, saved_axes)
+                engine._param_specs, saved_axes, lazy=True)
             m_trees = [s["optimizer"]["opt_state"]["m"] for s in states]
             m_tree = (None if m_trees[0] is None
                       else _combine_shard_states(m_trees,
                                                  engine._param_specs,
-                                                 saved_axes))
+                                                 saved_axes, lazy=True))
             v_trees = [s["optimizer"]["opt_state"]["v"] for s in states]
             v_tree = (None if v_trees[0] is None
                       else _combine_shard_states(v_trees,
                                                  engine._param_specs,
-                                                 saved_axes))
-            engine.master = jax.tree_util.tree_map(_put_global,
-                                                   engine.master, master)
-            engine.opt_state = type(engine.opt_state)(
-                step=jnp.asarray(state["optimizer"]["opt_state"]["step"]),
-                m=_put_like(engine.opt_state.m, m_tree),
-                v=_put_like(engine.opt_state.v, v_tree))
-            restored_masters = True
+                                                 saved_axes, lazy=True))
+            opt_pairs = [(engine.master, master),
+                         (engine.opt_state.m, m_tree),
+                         (engine.opt_state.v, v_tree)]
+
+    placed = _place_trees([(engine.params, module)] + opt_pairs, plan)
+    engine.params = placed[0]
+    if opt_pairs:
+        engine.master = placed[1]
+        engine.opt_state = type(engine.opt_state)(
+            # through _put_global, NOT a bare jnp.asarray: the step counter
+            # must come back with the engine's replicated sharding or the
+            # boundary program re-lowers with an unpinned scalar input —
+            # a different executable, so the persistent compile cache
+            # misses on every resume (the exact recompile fast resume
+            # exists to avoid)
+            step=_put_global(engine.opt_state.step,
+                             state["optimizer"]["opt_state"]["step"]),
+            m=placed[2], v=placed[3])
+        restored_masters = True
+    if load_optimizer_states and zero_flat:
+        _load_zero_checkpoint(engine, load_dir, tag, plan)
+        restored_masters = True
     if not restored_masters:
         # weights-only fine-tune (load_optimizer_states=False), or a
         # checkpoint whose optimizer states live elsewhere: the fp32 masters
@@ -1340,18 +1581,15 @@ def _rederive_masters(engine) -> None:
             engine.master, masters)
 
 
-def _put_like(old_tree, new_tree):
-    if old_tree is None:
-        return None
-    return jax.tree_util.tree_map(_put_global, old_tree, new_tree)
-
-
-def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
+def _load_zero_checkpoint(engine, load_dir: str, tag: str,
+                          plan: Optional[_RestorePlan] = None) -> None:
     """Reassemble the flat fp32 master + moments from per-partition shards
     saved under ANY dp world size, re-pad for the current topology
     (reference _load_zero_checkpoint :1034-1046 requires matching topology;
     we lift the DP restriction — MP and PP must match, like the
-    reference)."""
+    reference).  The shard-chunk reads stream through the restore plan:
+    master / m / v enter one pipelined plan, so the moments' partitions
+    read while the master is being placed and the params re-derived."""
     mp = engine.mp_world_size
     pp = getattr(engine, "pp_world_size", 1)
     meta = engine.flat_meta
@@ -1384,28 +1622,47 @@ def _load_zero_checkpoint(engine, load_dir: str, tag: str) -> None:
     table = [[_load_obj(zero_file(load_dir, tag, r, m))
               for r in range(saved_dp)] for m in range(rows)]
 
-    def reassemble(key, m):
-        flat = np.concatenate([np.asarray(s[key]) for s in table[m]])
-        assert flat.shape[0] == total, (key, flat.shape, total)
-        pad = meta.padded - total
-        if pad:
-            flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
-        return flat
+    def lazy_stack(key):
+        """Deferred [rows?, padded·repl] buffer for ``key``: the per-(row,
+        partition) shard chunks are the parts a reader pool fetches;
+        assembly concatenates each row, re-pads, and re-tiles for the
+        engine's sub-group layout (no-op at pps == dp)."""
+        parts = [table[m][r][key]
+                 for m in range(rows) for r in range(saved_dp)]
 
-    def stack(key):
-        if rows == 1:
-            return engine._tile_flat(reassemble(key, 0))
-        # each composite row re-tiles for the engine's sub-group layout
-        # (no-op at pps == dp)
-        return np.stack([engine._tile_flat(reassemble(key, m))
-                         for m in range(rows)])
+        def assemble(arrs):
+            mats = []
+            for m in range(rows):
+                flat = np.concatenate(
+                    [np.asarray(a)
+                     for a in arrs[m * saved_dp:(m + 1) * saved_dp]])
+                assert flat.shape[0] == total, (key, flat.shape, total)
+                pad = meta.padded - total
+                if pad:
+                    flat = np.concatenate(
+                        [flat, np.zeros((pad,), flat.dtype)])
+                mats.append(engine._tile_flat(flat))
+            return mats[0] if rows == 1 else np.stack(mats)
 
-    host_master = stack("master")
-    engine.master_flat = _put_global(engine.master_flat, host_master)
+        return LazyParts(parts, assemble)
+
+    stream = _stream_leaves(
+        [lazy_stack("master"), lazy_stack("m"), lazy_stack("v")],
+        plan or _RestorePlan())
+    try:
+        host_master = next(stream)
+        engine.master_flat = _put_global(engine.master_flat, host_master)
+        host_m = next(stream)
+        host_v = next(stream)
+    finally:
+        stream.close()
     engine.opt_state = type(engine.opt_state)(
-        step=jnp.asarray(table[0][0]["step"]),
-        m={"flat": _put_global(engine.opt_state.m["flat"], stack("m"))},
-        v={"flat": _put_global(engine.opt_state.v["flat"], stack("v"))})
+        # _put_global keeps the step counter's replicated sharding so the
+        # restored boundary step re-lowers to the SAME executable and the
+        # persistent compile cache can serve it (see the stage-3 site)
+        step=_put_global(engine.opt_state.step, table[0][0]["step"]),
+        m={"flat": _put_global(engine.opt_state.m["flat"], host_m)},
+        v={"flat": _put_global(engine.opt_state.v["flat"], host_v)})
     # params re-derived from the HOST copy of the restored master (bit-exact
     # resume; never device_gets the sharded global array — multi-host safe)
     engine.params = engine._params_from_master_flat(host_master)

@@ -114,12 +114,76 @@ class DeepSpeedConfig:
         self.sparse_gradients_enabled = get_scalar_param(
             pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
         # beyond-reference: background checkpoint writes (the stall is the
-        # device→host snapshot only; see checkpoint.save_checkpoint)
-        ckpt_sec = pd.get("checkpoint", {}) or {}
+        # device→host snapshot only; see checkpoint.save_checkpoint) and the
+        # parallel streaming restore (reader pool + readahead window on the
+        # preemption-resume critical path; docs/resilience.md)
+        ckpt_sec = pd.get(C.CHECKPOINT, {}) or {}
         if not isinstance(ckpt_sec, dict):
             raise DeepSpeedConfigError(
-                f"'checkpoint' must be a JSON object, got {ckpt_sec!r}")
-        self.checkpoint_async_save = bool(ckpt_sec.get("async_save", False))
+                f"'{C.CHECKPOINT}' must be a JSON object, got {ckpt_sec!r}")
+        ckpt_known = {C.CHECKPOINT_ASYNC_SAVE, C.CHECKPOINT_RESTORE_THREADS,
+                      C.CHECKPOINT_RESTORE_READAHEAD_MB}
+        if set(ckpt_sec) - ckpt_known:
+            # a typo'd restore knob would silently run the default path —
+            # loud, like the resilience section
+            raise DeepSpeedConfigError(
+                f"unknown {C.CHECKPOINT} key(s) "
+                f"{sorted(set(ckpt_sec) - ckpt_known)}; supported: "
+                f"{sorted(ckpt_known)}")
+        self.checkpoint_async_save = bool(ckpt_sec.get(
+            C.CHECKPOINT_ASYNC_SAVE, C.CHECKPOINT_ASYNC_SAVE_DEFAULT))
+        self.checkpoint_restore_threads = int(ckpt_sec.get(
+            C.CHECKPOINT_RESTORE_THREADS,
+            C.CHECKPOINT_RESTORE_THREADS_DEFAULT))
+        if self.checkpoint_restore_threads < 0:
+            raise DeepSpeedConfigError(
+                f"{C.CHECKPOINT}.{C.CHECKPOINT_RESTORE_THREADS} must be "
+                f">= 0 (0 = auto, 1 = serial fallback), got "
+                f"{self.checkpoint_restore_threads}")
+        try:
+            self.checkpoint_restore_readahead_mb = float(ckpt_sec.get(
+                C.CHECKPOINT_RESTORE_READAHEAD_MB,
+                C.CHECKPOINT_RESTORE_READAHEAD_MB_DEFAULT))
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"{C.CHECKPOINT}.{C.CHECKPOINT_RESTORE_READAHEAD_MB} must "
+                f"be a number of megabytes")
+        if self.checkpoint_restore_readahead_mb <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.CHECKPOINT}.{C.CHECKPOINT_RESTORE_READAHEAD_MB} must "
+                f"be > 0 (got {self.checkpoint_restore_readahead_mb})")
+
+        # persistent compilation cache: a relaunched worker reuses the prior
+        # attempt's compiled step programs (utils/compile_cache.py; the
+        # engine enables it at build, before any step function traces)
+        cc = pd.get(C.COMPILE_CACHE, None)
+        if isinstance(cc, str):
+            cc = {C.COMPILE_CACHE_DIR: cc}       # bare-string shorthand
+        if cc is not None and not isinstance(cc, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.COMPILE_CACHE}' must be a directory string or an "
+                f"object {{'dir': ..., 'min_entry_size_bytes': ...}}, got "
+                f"{cc!r}")
+        cc_known = {C.COMPILE_CACHE_DIR, C.COMPILE_CACHE_MIN_ENTRY_SIZE_BYTES}
+        if cc is not None and set(cc) - cc_known:
+            raise DeepSpeedConfigError(
+                f"unknown {C.COMPILE_CACHE} key(s) "
+                f"{sorted(set(cc) - cc_known)}; supported: "
+                f"{sorted(cc_known)}")
+        self.compile_cache_dir = get_scalar_param(
+            cc, C.COMPILE_CACHE_DIR, C.COMPILE_CACHE_DIR_DEFAULT)
+        if self.compile_cache_dir is not None \
+                and not isinstance(self.compile_cache_dir, str):
+            raise DeepSpeedConfigError(
+                f"{C.COMPILE_CACHE}.{C.COMPILE_CACHE_DIR} must be a "
+                f"directory path string, got {self.compile_cache_dir!r}")
+        self.compile_cache_min_entry_size_bytes = int(get_scalar_param(
+            cc, C.COMPILE_CACHE_MIN_ENTRY_SIZE_BYTES,
+            C.COMPILE_CACHE_MIN_ENTRY_SIZE_BYTES_DEFAULT))
+        if self.compile_cache_min_entry_size_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"{C.COMPILE_CACHE}.{C.COMPILE_CACHE_MIN_ENTRY_SIZE_BYTES} "
+                f"must be >= 0")
         self.pipeline_parallel_size = get_scalar_param(
             pd, C.PIPELINE_PARALLEL_SIZE, C.PIPELINE_PARALLEL_SIZE_DEFAULT)
         self.pipeline_schedule = get_scalar_param(

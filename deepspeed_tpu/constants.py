@@ -171,6 +171,42 @@ PROFILE_OUTPUT_PATH = "output_path"
 PROFILE_OUTPUT_PATH_DEFAULT = "/tmp/dstpu_profile"
 
 #############################################
+# Checkpoint IO (TPU-native: background writer thread + parallel streaming
+# restore — checkpoint.py, docs/resilience.md "Time to resume".  No
+# reference analog: v0.1.0 saves/loads synchronously through torch.save.)
+#############################################
+CHECKPOINT = "checkpoint"
+# write container files on a background thread; the training stall is the
+# device→host snapshot only
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = False
+# restore reader-pool width: 0 = auto (2 readers per core, capped at 8),
+# 1 = serial fallback (same plan executed inline — bitwise identical)
+CHECKPOINT_RESTORE_THREADS = "restore_threads"
+CHECKPOINT_RESTORE_THREADS_DEFAULT = 0
+# bound on in-flight read results beyond the leaf being placed — the
+# restore's peak host RAM is one window + one leaf, not the state tree
+CHECKPOINT_RESTORE_READAHEAD_MB = "restore_readahead_mb"
+CHECKPOINT_RESTORE_READAHEAD_MB_DEFAULT = 256.0
+
+#############################################
+# Persistent compilation cache (TPU-native: jax_compilation_cache_dir wired
+# through config so a relaunched/preempted worker reuses the prior
+# attempt's compiled step programs — time-to-first-step after a restart
+# becomes restore + cache READ instead of restore + full recompile.)
+#############################################
+COMPILE_CACHE = "compile_cache"
+# cache directory (shared across restart attempts; the launcher propagates
+# it to relaunched workers via DSTPU_COMPILE_CACHE_DIR).  None = disabled
+# unless the env var is set.
+COMPILE_CACHE_DIR = "dir"
+COMPILE_CACHE_DIR_DEFAULT = None
+# skip caching executables smaller than this (tiny programs recompile
+# faster than they deserialize; 0 = cache everything)
+COMPILE_CACHE_MIN_ENTRY_SIZE_BYTES = "min_entry_size_bytes"
+COMPILE_CACHE_MIN_ENTRY_SIZE_BYTES_DEFAULT = 0
+
+#############################################
 # Resilience (TPU-native: preemption-safe training, hang watchdog, NaN
 # sentinel, storage retry — deepspeed_tpu/resilience/, docs/resilience.md.
 # No reference analog: v0.1.0 assumes every host survives the run.)

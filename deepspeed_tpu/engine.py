@@ -377,6 +377,15 @@ class DeepSpeedTpuEngine:
 
         self.config = DeepSpeedConfig(cfg_src, dp_world_size=self.dp_world_size)
 
+        # -- persistent compilation cache (fast resume: a relaunched worker
+        #    reuses the prior attempt's compiled step programs).  Enabled
+        #    HERE, before any step function traces — the engine compiles
+        #    lazily, so every program this build produces goes through the
+        #    cache (utils/compile_cache.py; docs/resilience.md)
+        from deepspeed_tpu.utils import compile_cache as _compile_cache
+        self.compile_cache_dir = _compile_cache.enable_from_config(
+            self.config)
+
         # knobs the reference uses to schedule NCCL that XLA owns here —
         # accepted for config compatibility, but warn instead of silently
         # doing nothing (VERDICT r1 weak #6)
@@ -2460,12 +2469,20 @@ class DeepSpeedTpuEngine:
         """reference deepspeed_light.py:974-1046; returns (path,
         client_state)."""
         self._force_live_pendings()  # deferred forwards saw the old params
+        import time as _time
+
         from deepspeed_tpu import checkpoint as ckpt_mod
+        from deepspeed_tpu.resilience import COUNTERS
+        t0 = _time.perf_counter()
         with self._armed("load_checkpoint"):
             path, client = ckpt_mod.load_checkpoint(
                 self, load_dir, tag=tag,
                 load_optimizer_states=load_optimizer_states,
                 load_lr_scheduler_states=load_lr_scheduler_states)
+        if path is not None:
+            # restore sits on the preemption-resume critical path: keep its
+            # latency observable (Train/Resilience/restore_seconds)
+            COUNTERS.restore_seconds = _time.perf_counter() - t0
         return path, client
 
     # ------------------------------------------------- optimizer state (ckpt)

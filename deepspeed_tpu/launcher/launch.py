@@ -35,6 +35,7 @@ import time
 
 from deepspeed_tpu.launcher.run import decode_world_info
 from deepspeed_tpu.resilience import RESTARTABLE_EXIT_CODES
+from deepspeed_tpu.utils.compile_cache import ENV_DIR as COMPILE_CACHE_ENV_DIR
 
 logger = logging.getLogger(__name__)
 
@@ -58,6 +59,13 @@ def parse_args(args=None):
     parser.add_argument("--restart_backoff", type=float, default=1.0,
                         help="Base seconds of the jittered exponential "
                              "restart backoff")
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="Persistent jax compilation cache directory: "
+                             "exported to every spawned worker (including "
+                             "--max_restarts relaunches) as "
+                             "DSTPU_COMPILE_CACHE_DIR so time-to-first-step "
+                             "after a preemption is restore + cache read, "
+                             "not restore + full recompile")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -96,6 +104,12 @@ def _spawn_procs(args, local_ranks, world_size, node_host):
         env["WORLD_SIZE"] = str(world_size)
         env["RANK"] = str(global_rank)
         env["LOCAL_RANK"] = str(local_rank)
+        if args.compile_cache_dir:
+            # every attempt (first launch AND each restart) lands in the
+            # same persistent compilation cache — the engine's env
+            # fallback (utils/compile_cache.resolve_dir) picks it up even
+            # when the ds_config carries no compile_cache block
+            env[COMPILE_CACHE_ENV_DIR] = args.compile_cache_dir
         cmd = ([sys.executable, "-u", args.training_script]
                + args.training_script_args
                + [f"--local_rank={local_rank}"])

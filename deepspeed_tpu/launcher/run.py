@@ -69,6 +69,13 @@ def parse_args(args=None):
     parser.add_argument("--restart_backoff", type=float, default=1.0,
                         help="Base seconds of the jittered exponential "
                              "restart backoff")
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="Persistent jax compilation cache directory "
+                             "exported to every worker (and every "
+                             "--max_restarts relaunch) as "
+                             "DSTPU_COMPILE_CACHE_DIR, so a restarted "
+                             "process reuses the prior attempt's compiled "
+                             "step programs (docs/resilience.md)")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat a single-node pool as multi-node (ssh)")
     parser.add_argument("user_script", type=str,
@@ -84,8 +91,8 @@ def fetch_hostfile(hostfile_path):
     """Parse 'hostname slots=N' lines; None when absent (reference
     fetch_hostfile :88-113)."""
     if not os.path.isfile(hostfile_path):
-        logger.warning("Unable to find hostfile, will proceed with training "
-                       "with local resources only.")
+        logger.warning("no hostfile at %s — falling back to this machine's "
+                       "local slots only", hostfile_path)
         return None
     resource_pool = OrderedDict()
     with open(hostfile_path, "r") as fd:
@@ -98,12 +105,12 @@ def fetch_hostfile(hostfile_path):
                 _, slot_count = slots.split("=")
                 slot_count = int(slot_count)
             except ValueError:
-                logger.error("Hostfile is not formatted correctly, unable to "
-                             "proceed with training.")
+                logger.error("hostfile line %r does not parse as "
+                             "'<hostname> slots=<int>'", line)
                 raise ValueError(f"hostfile bad entry: {line!r}")
             if hostname in resource_pool:
-                logger.error("Hostfile contains duplicate hosts, unable to "
-                             "proceed with training.")
+                logger.error("hostfile lists %s twice — each host may "
+                             "appear on one line only", hostname)
                 raise ValueError(f"host {hostname} is already defined")
             resource_pool[hostname] = slot_count
     return resource_pool
@@ -262,6 +269,8 @@ def main(args=None):
     if args.max_restarts:
         launch_cmd += [f"--max_restarts={args.max_restarts}",
                        f"--restart_backoff={args.restart_backoff}"]
+    if args.compile_cache_dir:
+        launch_cmd += [f"--compile_cache_dir={args.compile_cache_dir}"]
 
     if not multi_node:
         cmd = launch_cmd + ["--node_rank=0", args.user_script] + args.user_args

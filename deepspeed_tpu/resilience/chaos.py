@@ -13,6 +13,12 @@ Injection points (wired by checkpoint.py and resilience.driver):
 ``io_point("ckpt_write")``      raises ``IOError`` for the first
                                 ``io_fail_writes`` checkpoint file writes
                                 (``DSTPU_CHAOS_IO_FAIL_WRITES``)
+``read_point("ckpt_read")``     raises ``IOError`` for the first
+                                ``io_fail_reads`` restore chunk reads
+                                (``DSTPU_CHAOS_IO_FAIL_READS``) — hit by
+                                every restore reader, serial or pooled,
+                                so the per-reader ``io_retry`` budget is
+                                exercisable deterministically
 ``step_point(step, rank)``      at ``sigterm_step`` on ``sigterm_rank``
                                 sends SIGTERM to this process
                                 (``DSTPU_CHAOS_SIGTERM_STEP`` /
@@ -38,11 +44,13 @@ from __future__ import annotations
 import logging
 import os
 import signal
+import threading
 import time
 
 logger = logging.getLogger(__name__)
 
 ENV_IO_FAIL_WRITES = "DSTPU_CHAOS_IO_FAIL_WRITES"
+ENV_IO_FAIL_READS = "DSTPU_CHAOS_IO_FAIL_READS"
 ENV_SIGTERM_STEP = "DSTPU_CHAOS_SIGTERM_STEP"
 ENV_CHAOS_RANK = "DSTPU_CHAOS_RANK"
 ENV_STALL_STEP = "DSTPU_CHAOS_STALL_STEP"
@@ -53,6 +61,7 @@ ENV_NAN_STEP = "DSTPU_CHAOS_NAN_STEP"
 class _State:
     def __init__(self):
         self.io_fail_writes = 0     # fail this many io_point() calls, then heal
+        self.io_fail_reads = 0      # fail this many read_point() calls
         self.sigterm_step = None    # SIGTERM self at this step
         self.sigterm_rank = None    # ...only on this rank (None = every rank)
         self.stall_step = None      # stall at this step
@@ -72,6 +81,7 @@ def reload_env() -> None:
     """(Re-)read the DSTPU_CHAOS_* env vars into the injection state —
     called once at import; call again after mutating os.environ in-process."""
     _state.io_fail_writes = _env_int(ENV_IO_FAIL_WRITES) or 0
+    _state.io_fail_reads = _env_int(ENV_IO_FAIL_READS) or 0
     _state.sigterm_step = _env_int(ENV_SIGTERM_STEP)
     _state.sigterm_rank = _env_int(ENV_CHAOS_RANK)
     _state.stall_step = _env_int(ENV_STALL_STEP)
@@ -81,10 +91,13 @@ def reload_env() -> None:
 
 def configure(io_fail_writes: int = None, sigterm_step: int = None,
               sigterm_rank: int = None, stall_step: int = None,
-              stall_s: float = None, nan_step: int = None) -> None:
+              stall_s: float = None, nan_step: int = None,
+              io_fail_reads: int = None) -> None:
     """Programmatic arming (in-process tests); only the passed points move."""
     if io_fail_writes is not None:
         _state.io_fail_writes = int(io_fail_writes)
+    if io_fail_reads is not None:
+        _state.io_fail_reads = int(io_fail_reads)
     if sigterm_step is not None:
         _state.sigterm_step = int(sigterm_step)
     if sigterm_rank is not None:
@@ -104,7 +117,8 @@ def reset() -> None:
 
 
 def armed() -> bool:
-    return bool(_state.io_fail_writes or _state.sigterm_step is not None
+    return bool(_state.io_fail_writes or _state.io_fail_reads
+                or _state.sigterm_step is not None
                 or _state.stall_step is not None
                 or _state.nan_step is not None)
 
@@ -119,6 +133,26 @@ def io_point(name: str = "ckpt_write") -> None:
         logger.warning("chaos: injected IO failure at %s (%d more armed)",
                        name, _state.io_fail_writes)
         raise IOError(f"chaos: injected IO failure at {name}")
+
+
+#: read_point runs on restore-pool reader THREADS — the decrement must be
+#: atomic or the armed count drifts (two readers both seeing 1)
+_read_lock = threading.Lock()
+
+
+def read_point(name: str = "ckpt_read") -> None:
+    """Storage-read injection point: raises IOError while armed reads
+    remain.  checkpoint._read_part calls this once per restore chunk, on
+    whichever thread (serial caller or pool reader) performs the read."""
+    if _state.io_fail_reads > 0:
+        with _read_lock:
+            if _state.io_fail_reads <= 0:
+                return
+            _state.io_fail_reads -= 1
+            remaining = _state.io_fail_reads
+        logger.warning("chaos: injected IO read failure at %s (%d more "
+                       "armed)", name, remaining)
+        raise IOError(f"chaos: injected IO read failure at {name}")
 
 
 def step_point(step: int, rank: int = 0) -> None:

@@ -32,13 +32,20 @@ class Counters:
     #: watchdog deadline expiries (stack dump emitted; process aborted when
     #: ``watchdog_abort`` is set)
     watchdog_fires: int = 0
+    #: wall-clock seconds of the most recent checkpoint restore
+    #: (engine.load_checkpoint) — the resume-latency half of fast resume
+    restore_seconds: float = 0.0
+    #: persistent-compilation-cache hits/misses (utils/compile_cache.py;
+    #: hits > 0 on a relaunch means the restart skipped recompilation)
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
 
     def as_dict(self) -> dict:
         return {f.name: getattr(self, f.name) for f in fields(self)}
 
     def reset(self) -> None:
         for f in fields(self):
-            setattr(self, f.name, 0)
+            setattr(self, f.name, f.default)
 
 
 #: process-wide counter instance (tests reset it between scenarios)

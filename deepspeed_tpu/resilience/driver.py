@@ -155,6 +155,14 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
     import jax
 
     engine = engine_factory()
+    cache_dir = getattr(engine, "compile_cache_dir", None)
+    if cache_dir:
+        # enable() exported DSTPU_COMPILE_CACHE_DIR, so in-process
+        # re-invocations and launcher relaunches (--max_restarts) all land
+        # in the same persistent compilation cache: a restarted attempt's
+        # time-to-first-step is restore + cache READ, not a full recompile
+        logger.info("resilience: persistent compilation cache at %s "
+                    "(kept across restart attempts)", cache_dir)
     # a default handler is OURS to uninstall on return: leaving it
     # installed would make the process permanently swallow Ctrl-C /
     # graceful SIGTERM after training finishes (a caller-provided handler

@@ -74,6 +74,56 @@ def _spec_axes(entry):
     return entry if isinstance(entry, tuple) else (entry,)
 
 
+class LazyParts:
+    """Deferred host leaf for the streaming checkpoint restore.
+
+    ``parts`` are the raw array sources (np.memmap chunk views into the
+    checkpoint container) and ``assemble(arrays)`` — arrays in ``parts``
+    order — builds the materialized leaf.  Threading these through the
+    host-side tree reassembly instead of eager ``np.concatenate`` lets the
+    restore path hand every chunk read to a reader pool and assemble each
+    leaf as its chunks land (checkpoint._stream_leaves); ``materialize()``
+    is the inline (serial) equivalent and produces bitwise the same value.
+    """
+
+    __slots__ = ("parts", "assemble")
+
+    def __init__(self, parts, assemble):
+        self.parts = list(parts)
+        self.assemble = assemble
+
+    def materialize(self):
+        return self.assemble([np.asarray(p) for p in self.parts])
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(getattr(p, "nbytes", 0)) for p in self.parts)
+
+    @classmethod
+    def wrap(cls, value) -> "LazyParts":
+        """Lift a plain array source into a single-part LazyParts."""
+        if isinstance(value, cls):
+            return value
+        return cls([value], lambda arrs: arrs[0])
+
+    @classmethod
+    def concat(cls, values, axis: int) -> "LazyParts":
+        """Compose: concatenate ``values`` (LazyParts or raw sources) along
+        ``axis``, keeping every underlying chunk an independent part."""
+        lazies = [cls.wrap(v) for v in values]
+        counts = [len(lz.parts) for lz in lazies]
+        subs = [lz.assemble for lz in lazies]
+
+        def assemble(arrs):
+            out, i = [], 0
+            for n, sub in zip(counts, subs):
+                out.append(sub(arrs[i:i + n]))
+                i += n
+            return np.concatenate(out, axis=axis)
+
+        return cls([p for lz in lazies for p in lz.parts], assemble)
+
+
 def _local_shape(shape, spec, axis_sizes) -> Tuple[int, ...]:
     """Per-device-group shape of a leaf under a PartitionSpec: each dim is
     divided by the product of the mesh-axis sizes sharding it."""
@@ -133,29 +183,37 @@ def norm_dedup_weights(meta: FlatMeta, specs, state_axes) -> np.ndarray:
     return np.concatenate(pieces)
 
 
-def combine_composite_trees(local_trees, specs, axes):
+def combine_composite_trees(local_trees, specs, axes, lazy=False):
     """Reassemble a global pytree from per-composite-rank local trees (host
     side).  ``axes`` is ``[(axis_name, size), ...]`` row-major (first axis
     slowest-varying — pipe before model); the innermost axis combines
     first.  Single owner of the composite-rank ordering invariant shared by
-    checkpoint reassembly and engine._params_from_master_flat."""
+    checkpoint reassembly and engine._params_from_master_flat.
+
+    ``lazy=True`` defers every model-sharded concatenation to
+    :class:`LazyParts` (streaming-restore callers only — the leaves reach
+    ``checkpoint._place_trees``, which schedules the underlying chunks on
+    the reader pool and assembles as they land)."""
     if len(local_trees) == 1:
         return local_trees[0]
     if len(axes) == 1:
-        return combine_local_trees(local_trees, specs, axes[0][0])
+        return combine_local_trees(local_trees, specs, axes[0][0],
+                                   lazy=lazy)
     inner = 1
     for _, n in axes[1:]:
         inner *= n
     outer = [combine_composite_trees(local_trees[o * inner:(o + 1) * inner],
-                                     specs, axes[1:])
+                                     specs, axes[1:], lazy=lazy)
              for o in range(axes[0][1])]
-    return combine_local_trees(outer, specs, axes[0][0])
+    return combine_local_trees(outer, specs, axes[0][0], lazy=lazy)
 
 
-def combine_local_trees(local_trees, specs, model_axis: str):
+def combine_local_trees(local_trees, specs, model_axis: str, lazy=False):
     """Reassemble a global pytree from per-model-shard local trees (host
     side): model-sharded leaves concatenate along their sharded dim,
-    replicated leaves are taken from shard 0."""
+    replicated leaves are taken from shard 0.  ``lazy=True`` (and any
+    already-deferred input leaf) keeps the concatenation deferred — see
+    :func:`combine_composite_trees`."""
     treedef = jax.tree_util.tree_structure(local_trees[0])
     spec_leaves = treedef.flatten_up_to(specs)
     all_leaves = [jax.tree_util.tree_leaves(t) for t in local_trees]
@@ -168,6 +226,13 @@ def combine_local_trees(local_trees, specs, model_axis: str):
                 break
         if dim is None:
             out.append(all_leaves[0][i])
+        elif lazy or any(isinstance(lv[i], LazyParts) for lv in all_leaves):
+            # streaming restore: keep the per-shard chunks independent so
+            # the reader pool schedules them (raw memmap sources would
+            # otherwise page-fault serially, GIL held, on the consumer);
+            # assembly is the SAME np.concatenate, just deferred
+            # (bitwise-identical)
+            out.append(LazyParts.concat([lv[i] for lv in all_leaves], dim))
         else:
             out.append(np.concatenate(
                 [np.asarray(lv[i]) for lv in all_leaves], axis=dim))

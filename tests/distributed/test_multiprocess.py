@@ -83,10 +83,17 @@ def test_chaos_sigterm_resume_zero1_multiprocess(tmpdir):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_chaos_sigterm_resume_zero3_multiprocess(tmpdir):
     """ISSUE 4 chaos proof, ZeRO-3 leg: same drain/resume contract with
     data-sharded parameters and the shard-native stage-3 checkpoint
-    format."""
+    format (through the parallel streaming restore — workers.py arms
+    restore_threads=4 with a 1 MB readahead window).
+
+    slow-tier (PR 5 tier-1 headroom rebalance): the ~55 s GPT2 spawn leg
+    moves off the 870 s tier-1 budget; the CI chaos job (``-m chaos``)
+    still runs it on every push, and the ZeRO-1 chaos leg — also armed
+    with the parallel restore — keeps preemption-resume in tier-1."""
     spawn_distributed("chaos_sigterm_resume_zero3", world_size=2,
                       local_devices=2,
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})

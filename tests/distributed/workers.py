@@ -307,8 +307,14 @@ def chaos_sigterm_resume_zero1():
     dataset = ArrayDataset(x, y)
 
     def factory():
+        cfg = dict(_ZERO_CFG)
+        # tier-1 keeps a preemption-resume leg on the PARALLEL streaming
+        # restore (the zero3 chaos leg also runs it, in the slow/chaos
+        # tier); tiny readahead so the window throttling is exercised
+        cfg["checkpoint"] = {"restore_threads": 4,
+                             "restore_readahead_mb": 1}
         engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=8),
-                                        config=dict(_ZERO_CFG))
+                                        config=cfg)
         return engine
 
     def make_loader():
@@ -336,6 +342,11 @@ def chaos_sigterm_resume_zero3():
         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 3},
+        # the resume-after-preemption proof runs through the PARALLEL
+        # streaming restore (reader pool + tiny readahead window so the
+        # window logic actually throttles) — bitwise parity with the
+        # uninterrupted run is asserted downstream
+        "checkpoint": {"restore_threads": 4, "restore_readahead_mb": 1},
     }
     rng = np.random.default_rng(7)
     toks = rng.integers(0, 64, size=(40, 16)).astype(np.int32)

@@ -528,7 +528,9 @@ def test_counters_exported_through_engine():
     _split_step(engine, _fp32_batch(0))
     got = engine.resilience_counters()
     assert set(got) == {"restarts", "preemptions", "nan_skips", "io_retries",
-                        "watchdog_near_misses", "watchdog_fires"}
+                        "watchdog_near_misses", "watchdog_fires",
+                        "restore_seconds", "compile_cache_hits",
+                        "compile_cache_misses"}
 
     class FakeWriter:
         def __init__(self):
