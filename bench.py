@@ -110,6 +110,35 @@ def _peak_tflops():
     return _PEAK_BF16_TFLOPS.get(kind, 459.0)
 
 
+def _plan_predictions(engine, batch, micro_n):
+    """Static capacity-planner columns for a bench row: predicted
+    per-device peak HBM of the fused train_batch program and the
+    predicted ZeRO-boundary wire time (docs/analysis.md "Capacity
+    planner") — prediction sits next to measurement in the committed
+    artifact so the next chip session can fit a goodput factor.
+    $BENCH_PROFILE picks the profile (default v4-8, the headline chip);
+    best-effort: the planner must never take down a bench run."""
+    try:
+        from deepspeed_tpu.analysis import profiles
+        prof = profiles.resolve(os.environ.get("BENCH_PROFILE", "v4-8"))
+        fused = engine.plan_capacity(batch, train=True, fused=True,
+                                     profile=prof)
+        micro = tuple(a[:micro_n] for a in batch)
+        split = engine.plan_capacity(micro, train=True, fused=False,
+                                     profile=prof)
+        boundary_ms = (split.boundary_comm.predicted_time_ms()
+                       if split.boundary_comm is not None else None)
+        return {
+            "predicted_peak_hbm_gb": round(fused.peak_bytes / 2**30, 4),
+            "predicted_boundary_ms": (round(boundary_ms, 4)
+                                      if boundary_ms is not None else None),
+            "predicted_profile": prof.name,
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"capacity-plan columns skipped: {e}", file=sys.stderr)
+        return {}
+
+
 def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
                warmup=2):
     import jax
@@ -192,6 +221,7 @@ def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
         "achieved_tflops": per_chip * flops / 1e12,
         "loss": last_loss,
         "n_params": n_params,
+        **_plan_predictions(engine, batch, batch_per_chip * n_chips),
     }
 
 
@@ -547,6 +577,11 @@ def run_mfu_breakdown():
            "gas": G, "batch_per_chip": mb,
            "per_chip": round(base_res["per_chip"], 2),
            "mfu": round(base_res["mfu"], 4),
+           # planner prediction next to measurement: diff these against
+           # the measured step/boundary next chip session
+           "predicted_peak_hbm_gb": base_res.get("predicted_peak_hbm_gb"),
+           "predicted_boundary_ms": base_res.get("predicted_boundary_ms"),
+           "predicted_profile": base_res.get("predicted_profile"),
            "ablation_step_s": {
                "base": round(base_s, 4),
                "half_layers": round(half_layers_s, 4),
@@ -656,6 +691,9 @@ def run_data_bench(steps=4, warmup=2):
            "unit": "x of synthetic throughput (1.0 = no input bottleneck)",
            "realdata_per_chip": round(per_chip, 2),
            "synthetic_per_chip": round(synth, 2),
+           "predicted_peak_hbm_gb": res.get("predicted_peak_hbm_gb"),
+           "predicted_boundary_ms": res.get("predicted_boundary_ms"),
+           "predicted_profile": res.get("predicted_profile"),
            "n_samples_on_disk": int(fields["input_ids"].shape[0]),
            "vocab": len(vocab)})
     return 0
@@ -1338,6 +1376,9 @@ def main():
         "vs_baseline": round(res["per_chip"] / 200.0, 3),
         "mfu": round(res["mfu"], 4),
         "achieved_tflops": round(res["achieved_tflops"], 1),
+        "predicted_peak_hbm_gb": res.get("predicted_peak_hbm_gb"),
+        "predicted_boundary_ms": res.get("predicted_boundary_ms"),
+        "predicted_profile": res.get("predicted_profile"),
         "batch_per_chip": batch_per_chip,
         "gas": gas,
         "remat": remat,

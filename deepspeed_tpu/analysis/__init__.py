@@ -30,10 +30,15 @@ from typing import Optional, Sequence
 import jax
 
 from deepspeed_tpu.analysis import graph  # noqa: F401  (re-export for users)
+from deepspeed_tpu.analysis import commplan  # noqa: F401
+from deepspeed_tpu.analysis import memplan  # noqa: F401
 from deepspeed_tpu.analysis import passes
+from deepspeed_tpu.analysis import profiles  # noqa: F401
+from deepspeed_tpu.analysis.memplan import (CapacityPlan, ProgramPlan,
+                                            analyze_program, plan_engine)
 from deepspeed_tpu.analysis.report import (ERROR, INFO, WARNING, Finding,
-                                           GraphLintError, Report,
-                                           ShardSpecError)
+                                           GraphLintError, MemoryPlanError,
+                                           Report, ShardSpecError)
 
 logger = logging.getLogger(__name__)
 
@@ -41,10 +46,12 @@ MODES = ("off", "warn", "error")
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Report", "GraphLintError",
-    "ShardSpecError", "MODES", "analyze_jaxpr", "analyze_step",
-    "analyze_engine", "analyze_engine_train_batch", "trace_train_batch",
-    "check_shard_specs",
+    "MemoryPlanError", "ShardSpecError", "MODES", "analyze_jaxpr",
+    "analyze_step", "analyze_engine", "analyze_engine_train_batch",
+    "trace_train_batch", "train_batch_args", "check_shard_specs",
     "validate_specs_or_raise", "dispatch_report",
+    "CapacityPlan", "ProgramPlan", "analyze_program", "plan_engine",
+    "commplan", "memplan", "profiles",
 ]
 
 
@@ -152,18 +159,26 @@ def analyze_engine(engine, batch, train: bool = True,
     return rep
 
 
-def trace_train_batch(engine, batch, fn=None):
-    """Jaxpr of the fused train_batch program with the engine's CURRENT
-    state as example args — the single owner of the step-function call
-    protocol (callers must not hand-marshal the 8-tuple; the overlap
-    microbench counts collectives through this too).  ``fn`` defaults to
-    the engine's built ``_train_batch_fn``."""
+def train_batch_args(engine, batch):
+    """The fused train_batch call tuple with the engine's CURRENT state —
+    THE single owner of the step-function call protocol.  Every caller
+    that needs the 8-tuple (the tracer below, the capacity planner, the
+    XLA-parity tests) marshals through here; hand-rolled copies drift
+    silently when the signature changes."""
     batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
     master = engine.master_flat if engine.zero_flat else engine.master
+    return (engine.params, master, engine.opt_state,
+            engine.loss_scale_state, engine._current_hypers(),
+            engine._zero_norm_w, engine._zero_gid_flat, batch)
+
+
+def trace_train_batch(engine, batch, fn=None):
+    """Jaxpr of the fused train_batch program (args via
+    :func:`train_batch_args`; the overlap microbench counts collectives
+    through this too).  ``fn`` defaults to the engine's built
+    ``_train_batch_fn``."""
     return jax.make_jaxpr(fn or engine._train_batch_fn)(
-        engine.params, master, engine.opt_state, engine.loss_scale_state,
-        engine._current_hypers(), engine._zero_norm_w,
-        engine._zero_gid_flat, batch)
+        *train_batch_args(engine, batch))
 
 
 def analyze_engine_train_batch(engine, batch) -> Report:
@@ -184,20 +199,28 @@ def analyze_engine_train_batch(engine, batch) -> Report:
 
 
 def dispatch_report(rep: Report, mode: str, where: str = "",
-                    log: Optional[logging.Logger] = None) -> Report:
-    """Apply a ``graph_lint.mode``: log warnings+errors in ``warn`` mode,
-    raise :class:`GraphLintError` on error findings in ``error`` mode."""
+                    log: Optional[logging.Logger] = None,
+                    label: str = "graph lint",
+                    info_hint: Optional[str] = None,
+                    error_cls=None) -> Report:
+    """Apply a ``graph_lint.mode``-style gate: log warnings+errors in
+    ``warn`` mode, raise ``error_cls`` (default :class:`GraphLintError`)
+    on error findings in ``error`` mode.  The capacity planner rides the
+    same dispatcher with ``label="capacity plan"`` and
+    ``error_cls=MemoryPlanError`` — one gate implementation, two pass
+    families."""
     log = log or logger
     if mode == "off" or not len(rep):
         return rep
     worst = rep.errors or rep.warnings
     if worst or rep.infos:
+        hint = (info_hint or "engine.run_graph_lint(batch).format() "
+                             "shows them")
         body = (rep.format(min_severity=WARNING) if worst else
-                f"{len(rep.infos)} info-severity finding(s); "
-                f"engine.run_graph_lint(batch).format() shows them")
+                f"{len(rep.infos)} info-severity finding(s); {hint}")
         log.log(logging.WARNING if worst else logging.INFO,
-                "graph lint%s: %s\n%s",
+                "%s%s: %s\n%s", label,
                 f" [{where}]" if where else "", rep.summary(), body)
     if mode == "error":
-        rep.raise_on_error(where=where)
+        rep.raise_on_error(where=where, error_cls=error_cls)
     return rep

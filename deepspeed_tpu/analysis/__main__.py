@@ -1,4 +1,5 @@
-"""``python -m deepspeed_tpu.analysis`` — graph-lint a DeepSpeed config.
+"""``python -m deepspeed_tpu.analysis`` — graph-lint / capacity-plan a
+DeepSpeed config.
 
 For each config file a representative model is built (inferred from the
 path: ``*bert*`` → tiny BertForPreTraining, ``*gpt2*`` → tiny GPT2,
@@ -8,6 +9,15 @@ printed.  Static analysis only — no optimizer step runs, no TPU is needed.
 
     python -m deepspeed_tpu.analysis examples/simple/ds_config.json
     python -m deepspeed_tpu.analysis --mode error examples/*/ds_config*.json
+    python -m deepspeed_tpu.analysis --plan --profile v4-8 <config>
+    python -m deepspeed_tpu.analysis --plan --json <config>   # CI artifact
+
+``--plan`` adds the capacity planner: predicted per-device peak HBM of
+the fused train_batch program, the persistent-state breakdown, bytes on
+wire per step and predicted wire time, gated against ``--profile``'s HBM
+(``memory.budget-exceeded`` is error severity).  ``--json`` emits one
+machine-readable JSON line per config (findings + plan table) so CI can
+artifact-diff lint/plan results across PRs.
 
 Exit status: 0 clean (or ``--mode warn``), 2 when error-severity findings
 survive suppression in ``--mode error``, 1 on usage/analysis failure.
@@ -163,7 +173,9 @@ def _build_model(family: str, seq_len: int, config_path: str = ""):
     return model, make_batch
 
 
-def _analyze_config(path: str, family: str, seq_len: int, suppress):
+def _analyze_config(path: str, family: str, seq_len: int, suppress,
+                    plan: bool = False, profile: str = None):
+    """(filtered lint Report, CapacityPlan | None) for one config."""
     import jax
 
     import deepspeed_tpu
@@ -171,10 +183,13 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress):
 
     with open(path) as f:
         cfg = json.load(f)
-    # the CLI decides lint dispatch itself; the engine must not also raise
+    # the CLI decides lint/plan dispatch itself; the engine must not also
+    # raise on its own config keys
     cfg.pop("graph_lint", None)
+    cfg.pop("analysis", None)
     family = _infer_family(path, family)
     model, make_batch = _build_model(family, seq_len, config_path=path)
+    cap = None
     try:
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, config=cfg,
@@ -182,6 +197,17 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress):
         batch = make_batch(engine.train_micro_batch_size_per_gpu()
                            * engine.dp_world_size)
         rep = analysis.analyze_engine(engine, batch, train=True)
+        if plan:
+            from deepspeed_tpu.analysis import profiles as prof_mod
+            prof = (prof_mod.resolve(profile) if profile
+                    else prof_mod.default_profile())
+            # the fused train_batch program needs the full effective batch
+            full = make_batch(engine.train_micro_batch_size_per_gpu()
+                              * engine.dp_world_size
+                              * engine.gradient_accumulation_steps())
+            cap = engine.plan_capacity(full, train=True, fused=True,
+                                       profile=prof)
+            rep.extend(cap.to_report(subject="train_batch"))
     finally:
         # engine build enables any configured persistent compile cache
         # PROCESS-WIDE (and exports the env fallback for relaunches) —
@@ -191,7 +217,7 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress):
         if compile_cache.enabled_dir() is not None:
             compile_cache.disable()
     rep.subject = f"{path} (model={family})"
-    return rep.filtered(suppress)
+    return rep.filtered(suppress), cap
 
 
 def main(argv=None) -> int:
@@ -219,6 +245,17 @@ def main(argv=None) -> int:
                          "--suppress precision.upcast")
     ap.add_argument("--verbose", "-v", action="store_true",
                     help="include info-severity findings in the report")
+    ap.add_argument("--plan", action="store_true",
+                    help="run the capacity planner: predicted per-device "
+                         "peak HBM + bytes on wire, gated against the "
+                         "--profile budget (docs/analysis.md)")
+    ap.add_argument("--profile", default=None,
+                    help="backend profile for --plan (v4-8, v5e-8, v5p-8, "
+                         "cpu-8; default: the running backend's profile)")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="emit one machine-readable JSON line per config "
+                         "(findings + plan) instead of the pretty report — "
+                         "the CI artifact format")
     args = ap.parse_args(argv)
 
     from deepspeed_tpu import analysis
@@ -227,8 +264,9 @@ def main(argv=None) -> int:
     failed = []
     for path in args.configs:
         try:
-            rep = _analyze_config(path, args.model, args.seq_len,
-                                  args.suppress)
+            rep, cap = _analyze_config(path, args.model, args.seq_len,
+                                       args.suppress, plan=args.plan,
+                                       profile=args.profile)
         except Exception as e:
             # keep analyzing the remaining configs so one broken config
             # does not hide whether the others are clean
@@ -236,15 +274,35 @@ def main(argv=None) -> int:
                   f"{e}", file=sys.stderr)
             failed.append(path)
             continue
-        print(f"== graph lint: {rep.subject} ==")
-        text = rep.format(min_severity=analysis.INFO if args.verbose
-                          else analysis.WARNING)
-        if text == "no findings" and rep.infos:
-            text = (f"no warning/error findings "
-                    f"({len(rep.infos)} info — use --verbose)")
-        print(text)
-        print(rep.summary())
-        print()
+        if args.json_out:
+            doc = {
+                "config": path,
+                "subject": rep.subject,
+                "mode": args.mode,
+                "findings": [{
+                    "code": f.code, "severity": f.severity,
+                    "message": f.message, "path": f.path,
+                    "source": f.source, "pass": f.pass_name,
+                } for f in rep.sorted()],
+                "suppressed_count": rep.suppressed_count,
+                "errors": len(rep.errors),
+                "warnings": len(rep.warnings),
+                "plan": cap.to_json() if cap is not None else None,
+            }
+            print(json.dumps(doc, sort_keys=True))
+        else:
+            print(f"== graph lint: {rep.subject} ==")
+            text = rep.format(min_severity=analysis.INFO if args.verbose
+                              else analysis.WARNING)
+            if text == "no findings" and rep.infos:
+                text = (f"no warning/error findings "
+                        f"({len(rep.infos)} info — use --verbose)")
+            print(text)
+            print(rep.summary())
+            if cap is not None:
+                print("-- capacity plan --")
+                print(cap.format_table())
+            print()
         total_errors += len(rep.errors)
 
     if failed:

@@ -1,0 +1,227 @@
+"""Capacity planner, wire half: static bytes-on-wire per step.
+
+Every collective in a step program already carries its wire format in the
+jaxpr — primitive, mesh axes, ``axis_index_groups``, operand shape/dtype
+— the exact signature the graph-lint collective pass hashes for deadlock
+detection.  This pass walks the same equations and prices them instead:
+ring-algorithm bytes per device per execution, multiplied through
+enclosing ``scan`` trip counts, rolled up per mesh axis, and converted to
+a predicted time by a :class:`~.profiles.BackendProfile`'s link table.
+
+Cost model (b = per-device operand bytes, n = participating group size):
+
+=================  =========================  =============================
+primitive          bytes on wire per device   why
+=================  =========================  =============================
+psum/pmax/pmin     2 b (n-1)/n                ring all-reduce =
+                                              reduce-scatter + all-gather
+all_gather         b_in (n-1)                 each device receives every
+                                              other shard (= b_out (n-1)/n)
+psum_scatter       b_in (n-1)/n               ring reduce-scatter
+all_to_all         b (n-1)/n                  each device keeps 1/n
+ppermute           b                          one neighbor hop
+=================  =========================  =============================
+
+Predicted times are NOMINAL-bandwidth lower bounds (profiles.py); the
+bench rows carry prediction next to measurement so a goodput factor can
+be fitted per chip generation.  ``collective.axis-unknown`` stays lint's
+job — this pass prices only axes the mesh actually has.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis import graph as G
+from deepspeed_tpu.analysis import profiles as prof_mod
+
+#: primitives priced, with their per-device wire-cost factor as a function
+#: of (operand bytes, group size)
+_REDUCE = ("psum", "pmax", "pmin", "pmean", "psum_invariant")
+_PRICED_PRIMS = frozenset(_REDUCE) | {
+    "all_gather", "psum_scatter", "reduce_scatter", "all_to_all",
+    "ppermute", "pshuffle", "pgather",
+}
+
+
+def _operand_bytes(eqn) -> int:
+    # memplan.nbytes carries the guards (symbolic dims refuse to guess
+    # small, itemsize clamps) — one byte model for both planner halves
+    from deepspeed_tpu.analysis import memplan
+
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if getattr(aval, "shape", None) is None:
+            continue
+        total += memplan.nbytes(aval)
+    return total
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def _group_size(eqn, mesh_shape: Dict[str, int]) -> int:
+    groups = eqn.params.get("axis_index_groups")
+    if groups is not None:
+        try:
+            return max(1, len(groups[0]))
+        except Exception:
+            pass
+    n = 1
+    for a in _axes_of(eqn):
+        n *= int(mesh_shape.get(a, 1))
+    return max(1, n)
+
+
+def _wire_bytes(prim: str, b: int, n: int) -> int:
+    if n <= 1:
+        return 0
+    if prim in _REDUCE:
+        return int(2 * b * (n - 1) / n)
+    if prim in ("all_gather", "pgather"):
+        return int(b * (n - 1))
+    if prim in ("psum_scatter", "reduce_scatter", "all_to_all"):
+        return int(b * (n - 1) / n)
+    if prim in ("ppermute", "pshuffle"):
+        return int(b)
+    return 0
+
+
+@dataclasses.dataclass
+class CollectiveCost:
+    """One collective site, trip-count multiplied."""
+
+    primitive: str
+    axes: Tuple[str, ...]
+    group_size: int
+    executions: int             # scan-trip product of the enclosing loops
+    bytes_per_execution: int    # wire bytes per device, one execution
+    path: str = ""
+    source: str = ""
+
+    @property
+    def bytes_total(self) -> int:
+        return self.executions * self.bytes_per_execution
+
+
+@dataclasses.dataclass
+class CommPlan:
+    """Bytes-on-wire roll-up of one step program."""
+
+    subject: str
+    costs: List[CollectiveCost]
+    mesh_shape: Dict[str, int]
+    profile: Optional[prof_mod.BackendProfile] = None
+    #: whether the planned mesh spans hosts — DCN-priced axes apply.
+    #: Set from ``jax.process_count()`` by the engine path.
+    multi_host: bool = False
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(c.bytes_total for c in self.costs)
+
+    def per_axis_bytes(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.costs:
+            # a multi-axis collective rides each axis's links; attribute
+            # the full payload to every named axis (conservative)
+            for a in c.axes:
+                out[a] = out.get(a, 0) + c.bytes_total
+        return out
+
+    def predicted_time_ms(self, multi_host: Optional[bool] = None
+                          ) -> Optional[float]:
+        """Lower-bound wire time per step: per-axis bytes over the
+        profile's nominal link rate; the ``data`` axis drops to DCN rate
+        when the mesh spans hosts (default: the plan's own
+        ``multi_host``, i.e. whether the planned mesh actually does)."""
+        if self.profile is None:
+            return None
+        if multi_host is None:
+            multi_host = self.multi_host
+        total_s = 0.0
+        for axis, nbytes in self.per_axis_bytes().items():
+            gibps = self.profile.ici_gibps
+            if multi_host and axis in prof_mod.DCN_AXES:
+                gibps = self.profile.dcn_gibps
+            if gibps > 0:
+                total_s += nbytes / (gibps * (1 << 30))
+        return total_s * 1e3
+
+    def format_summary(self) -> str:
+        per_axis = ", ".join(
+            f"{a}={b / 2**20:.2f}Mi"
+            for a, b in sorted(self.per_axis_bytes().items()))
+        t = self.predicted_time_ms()
+        t_s = f", predicted wire time {t:.3f} ms" if t is not None else ""
+        return (f"wire/step: {self.total_bytes / 2**20:.2f}Mi "
+                f"({per_axis or 'no collectives'}; "
+                f"{len(self.costs)} collective site(s){t_s})")
+
+    def to_json(self) -> dict:
+        return {
+            "subject": self.subject,
+            "total_bytes": self.total_bytes,
+            "per_axis_bytes": self.per_axis_bytes(),
+            "predicted_time_ms": self.predicted_time_ms(),
+            "multi_host": self.multi_host,
+            "collectives": [{
+                "primitive": c.primitive,
+                "axes": list(c.axes),
+                "group_size": c.group_size,
+                "executions": c.executions,
+                "bytes_per_execution": c.bytes_per_execution,
+                "bytes_total": c.bytes_total,
+                "source": c.source,
+            } for c in self.costs],
+        }
+
+
+def analyze_comm(jaxpr, mesh_shape: Dict[str, int],
+                 profile: Optional[prof_mod.BackendProfile] = None,
+                 subject: str = "", multi_host: bool = False) -> CommPlan:
+    """Price every collective in ``jaxpr`` (open or closed), multiplying
+    through enclosing scan trip counts.  ``cond``/``switch`` branches take
+    branch 0 — the collective-order lint already guarantees the branches
+    issue matching sequences, so any branch prices the program."""
+    costs: List[CollectiveCost] = []
+
+    def visit(j, trips: int, path: str) -> None:
+        jj = G._as_open_jaxpr(j)
+        if jj is None:
+            return
+        for eqn in jj.eqns:
+            name = eqn.primitive.name
+            if name in _PRICED_PRIMS:
+                n = _group_size(eqn, mesh_shape)
+                b = _operand_bytes(eqn)
+                costs.append(CollectiveCost(
+                    primitive=name, axes=_axes_of(eqn), group_size=n,
+                    executions=trips,
+                    bytes_per_execution=_wire_bytes(name, b, n),
+                    path=path, source=G.source_of(eqn)))
+            subs = G.subjaxprs(eqn)
+            if not subs:
+                continue
+            if name in ("cond", "switch") and len(subs) > 1:
+                label, sub = subs[0]
+                visit(sub, trips, f"{path}/{label}" if path else label)
+            elif name == "scan":
+                length = int(eqn.params.get("length", 1) or 1)
+                for label, sub in subs:
+                    visit(sub, trips * length,
+                          f"{path}/{label}" if path else label)
+            else:
+                for label, sub in subs:
+                    visit(sub, trips, f"{path}/{label}" if path else label)
+
+    visit(jaxpr, 1, "")
+    return CommPlan(subject=subject, costs=costs,
+                    mesh_shape=dict(mesh_shape), profile=profile,
+                    multi_host=multi_host)

@@ -1,0 +1,119 @@
+"""Per-backend capacity profiles for the planner passes.
+
+One :class:`BackendProfile` per chip generation: usable HBM per device,
+nominal interconnect bandwidth per mesh axis, peak matmul throughput, and
+the lowering quirks the memory model must reproduce.  These are the
+numbers the capacity planner (``memplan.py``/``commplan.py``) converts a
+traced step program into "fits / does not fit" and "milliseconds on the
+wire" with — and the seed of the backend capability probe ROADMAP item 3
+asks for: everything here is a *declared* capability the dispatch tables
+can eventually read instead of hard-coding platform checks.
+
+Bandwidths are NOMINAL link rates (the public per-chip ICI/DCN figures,
+not measured goodput); predicted times are therefore lower bounds — the
+bench artifact's measured column is the calibration partner
+(``bench_mfu_breakdown.json`` rows carry predicted + measured side by
+side so the next chip session can fit a goodput factor).
+
+Naming: ``<generation>-<devices>`` (``v4-8`` = a v4 slice of 8 devices),
+matching the TPU pod-slice convention.  ``resolve`` accepts the bare
+generation (``v4``) and defaults the device count to the current mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendProfile:
+    """Declared capability sheet of one accelerator generation."""
+
+    name: str
+    #: usable HBM per device in GiB (the planner's default memory budget).
+    #: Slightly under the marketing number: XLA reserves a slice for its
+    #: runtime + collective scratch.
+    hbm_gib: float
+    #: nominal ICI bandwidth per device per mesh axis, GiB/s (one
+    #: direction).  Collectives over in-slice axes (model/seq/pipe/data
+    #: within a slice) ride this.
+    ici_gibps: float
+    #: nominal DCN bandwidth per host, GiB/s — the rate the ``data`` axis
+    #: drops to when a mesh spans hosts over data-center network.
+    dcn_gibps: float
+    #: peak dense bf16 TFLOP/s per device — declared-capability seed for
+    #: the future backend probe (ROADMAP 3).  Nothing reads it yet:
+    #: bench.py keeps its own device-kind-keyed ``_PEAK_BF16_TFLOPS``
+    #: table for MFU (it covers generations, e.g. v6e, that have no
+    #: planner profile); keep the two in sync when adding a generation.
+    peak_bf16_tflops: float
+    #: XLA-CPU lowering quirk: sub-fp32 (fp16/bf16) dot operands are
+    #: materialized as fp32 copies because the host has no native
+    #: half-precision GEMM.  The memory model must count those copies on
+    #: CPU and must NOT count them on TPU.
+    lowp_dot_f32_copies: bool = False
+
+    @property
+    def hbm_bytes(self) -> int:
+        return int(self.hbm_gib * (1 << 30))
+
+
+#: Registry. HBM: usable = generation HBM minus ~1.3 GiB XLA runtime
+#: reserve. ICI/DCN: public per-chip one-way figures for a 3D-torus slice
+#: member (v4: 3 links x ~100 GB/s each is the all-links aggregate; the
+#: per-axis number below is one link pair).  CPU: the tier-1 rig — HBM is
+#: a host-RAM allowance per virtual device, "ICI" is shared memcpy.
+PROFILES: Dict[str, BackendProfile] = {
+    "v4-8": BackendProfile(
+        name="v4-8", hbm_gib=30.75, ici_gibps=90.0, dcn_gibps=6.25,
+        peak_bf16_tflops=275.0),
+    "v5e-8": BackendProfile(
+        name="v5e-8", hbm_gib=14.75, ici_gibps=45.0, dcn_gibps=6.25,
+        peak_bf16_tflops=197.0),
+    "v5p-8": BackendProfile(
+        name="v5p-8", hbm_gib=93.75, ici_gibps=150.0, dcn_gibps=6.25,
+        peak_bf16_tflops=459.0),
+    "cpu-8": BackendProfile(
+        name="cpu-8", hbm_gib=4.0, ici_gibps=10.0, dcn_gibps=10.0,
+        peak_bf16_tflops=1.0, lowp_dot_f32_copies=True),
+}
+
+#: axes that cross DCN when the mesh spans hosts (docs/scaling.md: data
+#: is the only axis that safely leaves the slice)
+DCN_AXES = frozenset({"data"})
+
+
+def resolve(name: str) -> BackendProfile:
+    """Profile by name; bare generations default to the 8-device slice
+    (``"v4"`` -> ``"v4-8"``)."""
+    key = str(name).strip().lower()
+    if key in PROFILES:
+        return PROFILES[key]
+    slice8 = f"{key}-8"
+    if slice8 in PROFILES:
+        return PROFILES[slice8]
+    raise KeyError(
+        f"unknown backend profile {name!r}; known: {sorted(PROFILES)}")
+
+
+def default_profile() -> Optional[BackendProfile]:
+    """Profile of the backend jax is actually running on (None when the
+    platform has no entry — the caller should then require an explicit
+    ``--profile``).  On CPU this turns on the fp32-dot-copy quirk that
+    makes predicted peaks comparable to ``compiled.memory_analysis()``."""
+    import jax
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        return PROFILES["cpu-8"]
+    if platform == "tpu":
+        kind = ""
+        try:
+            kind = jax.devices()[0].device_kind.lower()
+        except Exception:  # pragma: no cover - device probing is best-effort
+            pass
+        for gen in ("v5p", "v5e", "v4"):
+            if gen in kind.replace(" ", ""):
+                return PROFILES[f"{gen}-8"]
+    return None

@@ -43,6 +43,16 @@ class GraphLintError(Exception):
         super().__init__(head + ":\n" + report.format(min_severity=ERROR))
 
 
+class MemoryPlanError(GraphLintError):
+    """Raised in ``analysis.mode == "error"`` when the capacity planner's
+    predicted per-device peak HBM exceeds the configured budget
+    (``memory.budget-exceeded`` surviving suppression).  Subclasses
+    :class:`GraphLintError` so it rides the same severity/suppression
+    machinery and ``except GraphLintError`` handlers keep working; the
+    inherited ``__init__`` renders the error findings, which for the
+    planner carry the contributor table with leaf paths."""
+
+
 class ShardSpecError(ValueError):
     """A shard_map in/out spec cannot apply to the value it is paired with
     (unknown mesh axis, rank overflow, or a non-divisible dim).  Raised by
@@ -167,6 +177,9 @@ class Report:
         head = f"{self.subject}: " if self.subject else ""
         return head + ", ".join(bits)
 
-    def raise_on_error(self, where: str = "") -> None:
+    def raise_on_error(self, where: str = "", error_cls=None) -> None:
+        """``error_cls`` must be :class:`GraphLintError` or a subclass
+        (e.g. :class:`MemoryPlanError`) so every gate raises through one
+        renderer and one except-clause contract."""
         if self.errors:
-            raise GraphLintError(self, where=where)
+            raise (error_cls or GraphLintError)(self, where=where)

@@ -331,6 +331,67 @@ class DeepSpeedConfig:
                 f"rule-code prefixes, got {sup!r}")
         self.graph_lint_suppress = list(sup)
 
+        # capacity planner: static per-device peak-HBM + wire-cost
+        # analysis at step-build time (analysis/memplan.py,
+        # docs/analysis.md "Capacity planner").  Section shape mirrors
+        # graph_lint: {"mode": ..., "memory_budget_gb": ...,
+        # "profile": ..., "suppress": [...]}.
+        an = pd.get(C.ANALYSIS, None)
+        if an is not None and not isinstance(an, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.ANALYSIS}' must be an object "
+                f"{{'mode': ..., 'memory_budget_gb': ..., 'profile': ..., "
+                f"'suppress': [...]}}, got {an!r}")
+        an_known = {C.ANALYSIS_MODE, C.ANALYSIS_MEMORY_BUDGET_GB,
+                    C.ANALYSIS_PROFILE, C.ANALYSIS_SUPPRESS}
+        if an is not None and set(an) - an_known:
+            # a typo'd budget key would silently run ungated — loud, like
+            # the resilience section
+            raise DeepSpeedConfigError(
+                f"unknown {C.ANALYSIS} key(s) {sorted(set(an) - an_known)}; "
+                f"supported: {sorted(an_known)}")
+        self.analysis_mode = get_scalar_param(
+            an, C.ANALYSIS_MODE, C.ANALYSIS_MODE_DEFAULT)
+        if self.analysis_mode not in ("off", "warn", "error"):
+            raise DeepSpeedConfigError(
+                f"{C.ANALYSIS}.{C.ANALYSIS_MODE} must be 'off', 'warn' or "
+                f"'error', got {self.analysis_mode!r}")
+        budget = get_scalar_param(an, C.ANALYSIS_MEMORY_BUDGET_GB,
+                                  C.ANALYSIS_MEMORY_BUDGET_GB_DEFAULT)
+        if budget is not None:
+            try:
+                budget = float(budget)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.ANALYSIS}.{C.ANALYSIS_MEMORY_BUDGET_GB} must be a "
+                    f"number of GiB, got {budget!r}")
+            if budget <= 0:
+                raise DeepSpeedConfigError(
+                    f"{C.ANALYSIS}.{C.ANALYSIS_MEMORY_BUDGET_GB} must be "
+                    f"> 0 (got {budget})")
+        self.analysis_memory_budget_gb = budget
+        profile = get_scalar_param(an, C.ANALYSIS_PROFILE,
+                                   C.ANALYSIS_PROFILE_DEFAULT)
+        if profile is not None:
+            if not isinstance(profile, str):
+                raise DeepSpeedConfigError(
+                    f"{C.ANALYSIS}.{C.ANALYSIS_PROFILE} must be a profile "
+                    f"name string, got {profile!r}")
+            from deepspeed_tpu.analysis import profiles as _profiles
+            try:
+                _profiles.resolve(profile)
+            except KeyError as e:
+                raise DeepSpeedConfigError(str(e))
+        self.analysis_profile = profile
+        an_sup = get_scalar_param(an, C.ANALYSIS_SUPPRESS,
+                                  C.ANALYSIS_SUPPRESS_DEFAULT)
+        if (not isinstance(an_sup, (list, tuple))
+                or not all(isinstance(s, str) for s in an_sup)):
+            raise DeepSpeedConfigError(
+                f"{C.ANALYSIS}.{C.ANALYSIS_SUPPRESS} must be a list of "
+                f"rule-code prefixes, got {an_sup!r}")
+        self.analysis_suppress = list(an_sup)
+
         # resilience: preemption-safe training, hang watchdog, NaN
         # sentinel, storage retry (deepspeed_tpu/resilience/,
         # docs/resilience.md)

@@ -157,6 +157,29 @@ GRAPH_LINT_SUPPRESS = "suppress"      # list of rule-code prefixes
 GRAPH_LINT_SUPPRESS_DEFAULT = ()
 
 #############################################
+# Capacity planner (TPU-native: static per-device peak-HBM + bytes-on-wire
+# analysis of the step programs — analysis/memplan.py, analysis/commplan.py,
+# docs/analysis.md "Capacity planner".  No reference analog: predicting the
+# fit of a config before compile needs the jaxpr, which torch never has.)
+#############################################
+ANALYSIS = "analysis"
+ANALYSIS_MODE = "mode"
+ANALYSIS_MODE_DEFAULT = "off"         # "off" | "warn" | "error"
+# per-device peak-HBM budget in GiB; "error" mode raises MemoryPlanError
+# when the predicted peak exceeds it.  None + no profile = report-only.
+ANALYSIS_MEMORY_BUDGET_GB = "memory_budget_gb"
+ANALYSIS_MEMORY_BUDGET_GB_DEFAULT = None
+# backend profile name (analysis/profiles.py: "v4-8", "v5e-8", "v5p-8",
+# "cpu-8"); supplies the budget when memory_budget_gb is unset and the
+# link-bandwidth table for predicted wire time
+ANALYSIS_PROFILE = "profile"
+ANALYSIS_PROFILE_DEFAULT = None
+# rule-code prefixes to suppress (memory.*/comm.* families), same
+# exact/dotted-prefix semantics as graph_lint.suppress
+ANALYSIS_SUPPRESS = "suppress"
+ANALYSIS_SUPPRESS_DEFAULT = ()
+
+#############################################
 # Profiler (TPU-native: jax.profiler trace over a step window — the
 # tracing analog of wall_clock_breakdown, SURVEY §5 row 1)
 #############################################

@@ -785,6 +785,16 @@ class DeepSpeedTpuEngine:
         self._graph_lint_suppress = list(self.config.graph_lint_suppress)
         self._linted_keys = set()
 
+        # -- capacity planner (docs/analysis.md "Capacity planner"):
+        #    static per-device peak-HBM + wire-cost prediction of each
+        #    step program, once per (program kind, batch format).
+        #    "error" mode turns a predicted over-budget peak into a
+        #    build-time MemoryPlanError naming the top live-set
+        #    contributors — instead of an OOM after minutes of compile.
+        self._analysis_mode = self.config.analysis_mode
+        self._analysis_suppress = list(self.config.analysis_suppress)
+        self._planned_keys = set()
+
         if self.config.dump_state:
             self.dump_state()
 
@@ -1495,6 +1505,78 @@ class DeepSpeedTpuEngine:
         rep = graph_lint.analyze_engine(self, batch, train=train)
         return rep.filtered(self._graph_lint_suppress)
 
+    def plan_capacity(self, batch, train: bool = True, fused: bool = True,
+                      profile=None, budget_gb=None):
+        """Static capacity plan (per-device peak HBM + bytes on wire) for
+        ``batch``'s format — :class:`deepspeed_tpu.analysis.CapacityPlan`.
+        No compile, no execution: the programs are traced abstractly.
+        ``profile``/``budget_gb`` default to the config ``analysis``
+        section; an unset budget falls back to the explicitly chosen
+        profile's HBM, and with neither set the plan is report-only (the
+        running backend's profile still shapes the memory model)."""
+        from deepspeed_tpu.analysis import memplan, profiles
+        batch = _as_tuple(batch)
+        if profile is None and self.config.analysis_profile:
+            profile = profiles.resolve(self.config.analysis_profile)
+        if budget_gb is None:
+            budget_gb = self.config.analysis_memory_budget_gb
+        budget_bytes = (int(float(budget_gb) * (1 << 30))
+                        if budget_gb is not None else None)
+        if budget_bytes is None and profile is not None:
+            # budget falls back to an EXPLICITLY chosen profile's HBM
+            # (caller arg or config key).  With neither set, the plan is
+            # report-only — plan_engine's own quirk-profile default must
+            # never turn into a surprise budget (cpu-8's 4 GiB would gate
+            # every real config built on a dev box).
+            budget_bytes = profile.hbm_bytes
+        return memplan.plan_engine(self, batch, train=train, fused=fused,
+                                   profile=profile,
+                                   budget_bytes=budget_bytes)
+
+    def _donate_argnums(self, fused):
+        """jit donation of the step programs — the single source both the
+        builders (_build_train_batch/_build_step) and the capacity
+        planner read, so the planner's output-aliasing model can never
+        drift from the compiled donation.  fp32 compute skips donating
+        params/master (fused) or master (split): their output buffers may
+        alias through the identity cast (see the builder comments)."""
+        if fused:
+            return ((2, 3) if self.policy.compute_dtype == jnp.float32
+                    else (0, 1, 2, 3))
+        return ((1, 2, 3) if self.policy.compute_dtype == jnp.float32
+                else (0, 1, 2, 3))
+
+    def _maybe_capacity_plan(self, kind, key, run):
+        """Run the capacity planner once per (program kind, batch format)
+        and dispatch per ``analysis.mode`` through the same
+        :func:`~deepspeed_tpu.analysis.dispatch_report` gate as graph
+        lint — 'error' mode raises
+        :class:`~deepspeed_tpu.analysis.MemoryPlanError` at build time.
+        Planner failures warn and move on — the planner must never take
+        down a healthy build."""
+        mode = self._analysis_mode
+        if mode == "off" or (kind, key) in self._planned_keys:
+            return
+        self._planned_keys.add((kind, key))
+        try:
+            rep = run().to_report(subject=kind)
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning("capacity plan could not analyze %s: %s",
+                           kind, e)
+            return
+        rep = rep.filtered(self._analysis_suppress)
+        try:
+            graph_lint.dispatch_report(
+                rep, mode, where=kind, log=logger, label="capacity plan",
+                info_hint="engine.plan_capacity(batch).format_table() "
+                          "shows the plan",
+                error_cls=graph_lint.MemoryPlanError)
+        except graph_lint.GraphLintError:
+            # sticky like graph lint: a retried build must plan (and
+            # fail) again, not silently proceed to an OOM
+            self._planned_keys.discard((kind, key))
+            raise
+
     def _ensure_fwdbwd(self, batch, key=None):
         """Build-or-fetch the fused fwd+bwd program for this batch format
         (shared by forward() and the graph-lint tracer)."""
@@ -1589,6 +1671,9 @@ class DeepSpeedTpuEngine:
             self._maybe_graph_lint(
                 "train", key,
                 lambda: graph_lint.analyze_engine(self, batch, train=True))
+            self._maybe_capacity_plan(
+                "train", key,
+                lambda: self.plan_capacity(batch, train=True, fused=False))
             if self._loss_treedef is None:
                 loss_shape, _ = jax.eval_shape(
                     self._fwdbwd_fn, self.params,
@@ -1617,6 +1702,9 @@ class DeepSpeedTpuEngine:
             self._maybe_graph_lint(
                 "eval", key,
                 lambda: graph_lint.analyze_engine(self, batch, train=False))
+            self._maybe_capacity_plan(
+                "eval", key,
+                lambda: self.plan_capacity(batch, train=False))
             loss = self._eval_fn(self.params, batch)
             self._last_loss = loss
             if wcb:
@@ -2062,9 +2150,7 @@ class DeepSpeedTpuEngine:
         # may alias — donating master would then invalidate the buffer
         # self.params still references; skip it there (same guard as
         # _build_train_batch).
-        donate = ((1, 2, 3) if self.policy.compute_dtype == jnp.float32
-                  else (0, 1, 2, 3))
-        return jax.jit(fn, donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=self._donate_argnums(fused=False))
 
     def dump_state(self):
         """Config + engine-state + memory dump (reference dump_state,
@@ -2370,9 +2456,7 @@ class DeepSpeedTpuEngine:
         # output params and master buffers — donating either on the next call
         # would donate a buffer that is also passed as the other argument;
         # donate only the optimizer/loss-scale state there.
-        donate = ((2, 3) if self.policy.compute_dtype == jnp.float32
-                  else (0, 1, 2, 3))
-        return jax.jit(fn, donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=self._donate_argnums(fused=True))
 
     def train_batch(self, batch):
         """Forward+backward+step over a full effective batch whose leaves
@@ -2407,6 +2491,9 @@ class DeepSpeedTpuEngine:
         self._maybe_graph_lint(
             "train_batch", key,
             lambda: graph_lint.analyze_engine_train_batch(self, batch))
+        self._maybe_capacity_plan(
+            "train_batch", key,
+            lambda: self.plan_capacity(batch, train=True, fused=True))
         master = self.master_flat if self.zero_flat else self.master
         # armed through the boundary's host sync (see step()): a hung
         # collective inside the fused program surfaces at the overflow

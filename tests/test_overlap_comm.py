@@ -253,7 +253,12 @@ def test_zero3_prefetch_memory_envelope():
     gathered layer threaded through the scan carry would be saved per
     iteration, resurrecting the full unsharded weight set in the backward
     (the review-caught failure mode).  Pinned via XLA's memory analysis:
-    prefetch temp memory stays within on-demand + ~2 gathered layers."""
+    prefetch temp memory stays within on-demand + ~2 gathered layers.
+    The same contract is asserted STATICALLY at engine level by the
+    capacity planner — tests/test_memplan.py
+    test_zero3_prefetch_envelope_is_computed pins the planner's computed
+    two-layer envelope and its traced-program prediction without a
+    compile."""
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu import zero3 as Z
