@@ -48,7 +48,8 @@ __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Report", "GraphLintError",
     "MemoryPlanError", "ShardSpecError", "MODES", "analyze_jaxpr",
     "analyze_step", "analyze_engine", "analyze_engine_train_batch",
-    "trace_train_batch", "train_batch_args", "check_shard_specs",
+    "trace_train_batch", "train_batch_args", "step_args",
+    "check_shard_specs",
     "validate_specs_or_raise", "dispatch_report",
     "CapacityPlan", "ProgramPlan", "analyze_program", "plan_engine",
     "commplan", "memplan", "profiles",
@@ -162,14 +163,34 @@ def analyze_engine(engine, batch, train: bool = True,
 def train_batch_args(engine, batch):
     """The fused train_batch call tuple with the engine's CURRENT state —
     THE single owner of the step-function call protocol.  Every caller
-    that needs the 8-tuple (the tracer below, the capacity planner, the
-    XLA-parity tests) marshals through here; hand-rolled copies drift
-    silently when the signature changes."""
+    that needs the tuple (the tracer below, the capacity planner, the
+    XLA-parity tests, the engine itself) marshals through here;
+    hand-rolled copies drift silently when the signature changes.  With
+    the metric spool on (``observability.report_window``) the tuple grows
+    a trailing spool-state argument — the device ring buffer the compiled
+    step appends this boundary's metrics into."""
     batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
     master = engine.master_flat if engine.zero_flat else engine.master
-    return (engine.params, master, engine.opt_state,
+    args = (engine.params, master, engine.opt_state,
             engine.loss_scale_state, engine._current_hypers(),
             engine._zero_norm_w, engine._zero_gid_flat, batch)
+    spool = getattr(engine, "_spool", None)
+    if spool is not None:
+        args = args + (spool.state,)
+    return args
+
+
+def step_args(engine, grads):
+    """The split-API boundary step call tuple (engine._step_fn's 7-arg
+    protocol) with the engine's CURRENT state — single owner, like
+    :func:`train_batch_args`: the engine's ``step()``, the capacity
+    planner's split branch, and the bench boundary microbench all marshal
+    through here.  ``grads`` is the accumulated-grad slot (real arrays or
+    ShapeDtypeStructs)."""
+    master = engine.master_flat if engine.zero_flat else engine.master
+    return (master, engine.opt_state, grads, engine.loss_scale_state,
+            engine._current_hypers(), engine._zero_norm_w,
+            engine._zero_gid_flat)
 
 
 def trace_train_batch(engine, batch, fn=None):

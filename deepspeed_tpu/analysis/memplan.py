@@ -550,13 +550,23 @@ def zero3_prefetch_transient_bytes(engine) -> int:
 
 def _engine_train_batch_args(engine, batch):
     # the protocol owner lives in the package __init__ (PR 3: callers
-    # must not hand-marshal the 8-tuple); lazy import avoids the cycle
+    # must not hand-marshal the tuple); lazy import avoids the cycle
     from deepspeed_tpu import analysis
     return analysis.train_batch_args(engine, batch)
 
 
+def _engine_step_args(engine, grads):
+    from deepspeed_tpu import analysis
+    return analysis.step_args(engine, grads)
+
+
+#: argument labels of the fused call protocol (analysis.train_batch_args).
+#: The optional metric-spool state is appended LAST — argument offsets 0..7
+#: stay aligned with the shard_map body invars whether or not it is there
+#: (the spool append runs OUTSIDE the shard_map, at the jit level).
 _TRAIN_BATCH_LABELS = ("params", "master", "opt_state", "loss_scale",
-                       "hypers", "zero_norm_w", "zero_gid", "batch")
+                       "hypers", "zero_norm_w", "zero_gid", "batch",
+                       "spool")
 
 
 def plan_engine(engine, batch, train: bool = True,
@@ -614,10 +624,7 @@ def plan_engine(engine, batch, train: bool = True,
         _, grad_shapes = jax.eval_shape(fwdbwd, *fb_args)
         if engine._step_fn is None:
             engine._step_fn = engine._build_step()
-        master = engine.master_flat if engine.zero_flat else engine.master
-        st_args = (master, engine.opt_state, grad_shapes,
-                   engine.loss_scale_state, engine._current_hypers(),
-                   engine._zero_norm_w, engine._zero_gid_flat)
+        st_args = _engine_step_args(engine, grad_shapes)
         donate = engine._donate_argnums(fused=False)
         st_closed = jax.make_jaxpr(engine._step_fn)(*st_args)
         programs.append(analyze_program(

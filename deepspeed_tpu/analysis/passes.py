@@ -397,8 +397,23 @@ def check_precision(jaxpr, report: R.Report) -> None:
 # Pass 3: transfers / recompilation
 # ======================================================================
 
+def _is_spool_drain(eqn) -> bool:
+    """Allowlist check: the telemetry drain callback carries a
+    ``_dstpu_spool_drain`` marker on the wrapped host function
+    (observability/spool.py sets it on the one function it passes to
+    ``io_callback``).  Matching on the marker — not the primitive — means
+    any OTHER io_callback in a step program still errors."""
+    cb = eqn.params.get("callback")
+    if cb is None:
+        return False
+    fn = getattr(cb, "callback_func", None) or getattr(cb, "f", None) or cb
+    return bool(getattr(fn, "_dstpu_spool_drain", False))
+
+
 def check_transfers(jaxpr, report: R.Report) -> None:
-    """Pass 3: host callbacks, weak-typed inputs, donation opportunities."""
+    """Pass 3: host callbacks, weak-typed inputs, donation opportunities.
+    The telemetry spool's once-per-window drain callback is allowlisted
+    (``transfer.spool-drain``, info) — see :func:`_is_spool_drain`."""
     jj = G._as_open_jaxpr(jaxpr)
     if jj is None:
         return
@@ -420,6 +435,21 @@ def check_transfers(jaxpr, report: R.Report) -> None:
     for eqn, path in G.walk(jj):
         name = eqn.primitive.name
         if name in HARD_CALLBACK_PRIMS:
+            if _is_spool_drain(eqn):
+                # the ONE sanctioned ordered host transfer: the telemetry
+                # MetricSpool's batched drain callback — dispatched once
+                # per report window (never per step), reading a tiny ring
+                # buffer the compiled step filled on device
+                # (observability/spool.py).  An UNSPOOLED per-step
+                # io_callback still takes the error branch below.
+                report.add(
+                    "transfer.spool-drain", R.INFO,
+                    f"{name} is the telemetry MetricSpool drain — an "
+                    f"allowlisted ordered host transfer batched once per "
+                    f"report window (docs/observability.md)",
+                    path=path, source=G.source_of(eqn),
+                    pass_name="transfers")
+                continue
             report.add(
                 "transfer.host-callback", R.ERROR,
                 f"{name} embeds a host round trip in the step program: "

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 from typing import Any, Mapping, Optional
 
 from deepspeed_tpu import constants as C
@@ -435,6 +436,129 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"{C.RESILIENCE}.{C.RESILIENCE_IO_RETRIES} must be >= 0")
 
+        # observability: spooled on-device metrics, step tracing, goodput
+        # accounting (deepspeed_tpu/observability/, docs/observability.md)
+        obs = pd.get(C.OBSERVABILITY, None)
+        if obs is not None and not isinstance(obs, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.OBSERVABILITY}' must be a JSON object, got {obs!r}")
+        obs_known = {C.OBSERVABILITY_REPORT_WINDOW,
+                     C.OBSERVABILITY_JSONL_PATH, C.OBSERVABILITY_TRACE_DIR,
+                     C.OBSERVABILITY_TRACE_START_STEP,
+                     C.OBSERVABILITY_TRACE_NUM_STEPS,
+                     C.OBSERVABILITY_HANG_CAPTURE,
+                     C.OBSERVABILITY_HANG_CAPTURE_S,
+                     C.OBSERVABILITY_PLANNER_DRIFT,
+                     C.OBSERVABILITY_FLOPS_PER_SAMPLE,
+                     C.OBSERVABILITY_PEAK_TFLOPS}
+        if obs is not None and set(obs) - obs_known:
+            # a typo'd window/trace knob would silently run the legacy
+            # fenced paths — loud, like the resilience section
+            raise DeepSpeedConfigError(
+                f"unknown {C.OBSERVABILITY} key(s) "
+                f"{sorted(set(obs) - obs_known)}; supported: "
+                f"{sorted(obs_known)}")
+        def _obs_num(key, default, cast):
+            val = get_scalar_param(obs, key, default)
+            try:
+                return cast(val)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.OBSERVABILITY}.{key} must be a number, got "
+                    f"{val!r}")
+
+        self.observability_report_window = _obs_num(
+            C.OBSERVABILITY_REPORT_WINDOW,
+            C.OBSERVABILITY_REPORT_WINDOW_DEFAULT, int)
+        if self.observability_report_window < 0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_REPORT_WINDOW} must be "
+                f">= 0 (0 disables the metric spool)")
+        self.observability_jsonl_path = get_scalar_param(
+            obs, C.OBSERVABILITY_JSONL_PATH,
+            C.OBSERVABILITY_JSONL_PATH_DEFAULT)
+        if self.observability_jsonl_path is not None \
+                and not isinstance(self.observability_jsonl_path, str):
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_JSONL_PATH} must be a "
+                f"path string, got {self.observability_jsonl_path!r}")
+        if (self.observability_jsonl_path
+                and self.observability_report_window < 1):
+            # events are emitted at window drains only — without a window
+            # the log would be created and stay empty forever, failing any
+            # validator-gated workflow long after the misconfiguration
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_JSONL_PATH} requires "
+                f"{C.OBSERVABILITY_REPORT_WINDOW} >= 1 (the JSONL event "
+                f"log carries one line per drained metric window)")
+        self.observability_trace_dir = get_scalar_param(
+            obs, C.OBSERVABILITY_TRACE_DIR,
+            C.OBSERVABILITY_TRACE_DIR_DEFAULT)
+        if self.observability_trace_dir is not None \
+                and not isinstance(self.observability_trace_dir, str):
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_TRACE_DIR} must be a "
+                f"directory string, got {self.observability_trace_dir!r}")
+        self.observability_trace_start_step = _obs_num(
+            C.OBSERVABILITY_TRACE_START_STEP,
+            C.OBSERVABILITY_TRACE_START_STEP_DEFAULT, int)
+        self.observability_trace_num_steps = _obs_num(
+            C.OBSERVABILITY_TRACE_NUM_STEPS,
+            C.OBSERVABILITY_TRACE_NUM_STEPS_DEFAULT, int)
+        if self.observability_trace_num_steps < 0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_TRACE_NUM_STEPS} must "
+                f"be >= 0 (0 disables the scheduled capture window)")
+        if (self.observability_trace_num_steps > 0
+                and not self.observability_trace_dir):
+            from deepspeed_tpu.observability.tracing import ENV_TRACE_DIR
+            if not os.environ.get(ENV_TRACE_DIR):
+                raise DeepSpeedConfigError(
+                    f"{C.OBSERVABILITY}.{C.OBSERVABILITY_TRACE_NUM_STEPS} "
+                    f"> 0 needs a trace destination: set "
+                    f"{C.OBSERVABILITY_TRACE_DIR} or {ENV_TRACE_DIR}")
+        self.observability_hang_capture = bool(get_scalar_param(
+            obs, C.OBSERVABILITY_HANG_CAPTURE,
+            C.OBSERVABILITY_HANG_CAPTURE_DEFAULT))
+        self.observability_hang_capture_s = _obs_num(
+            C.OBSERVABILITY_HANG_CAPTURE_S,
+            C.OBSERVABILITY_HANG_CAPTURE_S_DEFAULT, float)
+        if self.observability_hang_capture_s <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_HANG_CAPTURE_S} must "
+                f"be > 0")
+        self.observability_planner_drift = bool(get_scalar_param(
+            obs, C.OBSERVABILITY_PLANNER_DRIFT,
+            C.OBSERVABILITY_PLANNER_DRIFT_DEFAULT))
+        fps = get_scalar_param(obs, C.OBSERVABILITY_FLOPS_PER_SAMPLE,
+                               C.OBSERVABILITY_FLOPS_PER_SAMPLE_DEFAULT)
+        if fps is not None:
+            try:
+                fps = float(fps)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.OBSERVABILITY}.{C.OBSERVABILITY_FLOPS_PER_SAMPLE} "
+                    f"must be a number of FLOPs, got {fps!r}")
+            if fps <= 0:
+                raise DeepSpeedConfigError(
+                    f"{C.OBSERVABILITY}.{C.OBSERVABILITY_FLOPS_PER_SAMPLE} "
+                    f"must be > 0")
+        self.observability_flops_per_sample = fps
+        ptf = get_scalar_param(obs, C.OBSERVABILITY_PEAK_TFLOPS,
+                               C.OBSERVABILITY_PEAK_TFLOPS_DEFAULT)
+        if ptf is not None:
+            try:
+                ptf = float(ptf)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.OBSERVABILITY}.{C.OBSERVABILITY_PEAK_TFLOPS} must "
+                    f"be a number of TFLOP/s, got {ptf!r}")
+            if ptf <= 0:
+                raise DeepSpeedConfigError(
+                    f"{C.OBSERVABILITY}.{C.OBSERVABILITY_PEAK_TFLOPS} must "
+                    f"be > 0")
+        self.observability_peak_tflops_per_chip = ptf
+
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)
         prof = pd.get(C.PROFILE, None) or {}
@@ -450,6 +574,14 @@ class DeepSpeedConfig:
                 self.profile_end_step <= self.profile_start_step:
             raise DeepSpeedConfigError(
                 "profile.end_step must be greater than profile.start_step")
+        if self.profile_enabled and self.observability_trace_num_steps > 0:
+            # two owners of jax.profiler.start_trace would race; the
+            # observability section is the maintained spelling
+            raise DeepSpeedConfigError(
+                f"the legacy '{C.PROFILE}' section and "
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_TRACE_NUM_STEPS} both "
+                f"schedule a profiler capture window — use the "
+                f"'{C.OBSERVABILITY}' section only (docs/observability.md)")
 
         self.model_parallel_size = get_scalar_param(
             pd, C.MODEL_PARALLEL_SIZE, C.MODEL_PARALLEL_SIZE_DEFAULT)

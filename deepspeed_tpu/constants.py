@@ -194,6 +194,50 @@ PROFILE_OUTPUT_PATH = "output_path"
 PROFILE_OUTPUT_PATH_DEFAULT = "/tmp/dstpu_profile"
 
 #############################################
+# Observability (TPU-native telemetry layer — deepspeed_tpu/observability/,
+# docs/observability.md.  Reference analog: deepspeed_timer.py fenced the
+# host with torch.cuda.synchronize on every span; here metrics spool
+# through a device-side ring buffer drained once per report window, so the
+# per-step path carries ZERO host fences.)
+#############################################
+OBSERVABILITY = "observability"
+# boundaries per metric window: >= 1 enables the MetricSpool (device ring
+# buffer + one batched drain callback per window); 0 keeps the legacy
+# per-boundary reporting paths
+OBSERVABILITY_REPORT_WINDOW = "report_window"
+OBSERVABILITY_REPORT_WINDOW_DEFAULT = 0
+# schema-versioned JSONL event log, one line per window (process 0);
+# validated by `python -m deepspeed_tpu.observability <path>`
+OBSERVABILITY_JSONL_PATH = "jsonl_path"
+OBSERVABILITY_JSONL_PATH_DEFAULT = None
+# jax.profiler capture destination (env fallback DSTPU_TRACE_DIR — how
+# `dst --trace_dir` hands it to every worker); also where watchdog hang
+# captures land
+OBSERVABILITY_TRACE_DIR = "trace_dir"
+OBSERVABILITY_TRACE_DIR_DEFAULT = None
+OBSERVABILITY_TRACE_START_STEP = "trace_start_step"
+OBSERVABILITY_TRACE_START_STEP_DEFAULT = 10
+# > 0 schedules a [start, start + num) capture window (supersedes the
+# legacy `profile` section; configuring both is a config error)
+OBSERVABILITY_TRACE_NUM_STEPS = "trace_num_steps"
+OBSERVABILITY_TRACE_NUM_STEPS_DEFAULT = 0
+# record a short trace when the resilience watchdog fires (needs trace_dir)
+OBSERVABILITY_HANG_CAPTURE = "hang_capture"
+OBSERVABILITY_HANG_CAPTURE_DEFAULT = True
+OBSERVABILITY_HANG_CAPTURE_S = "hang_capture_s"
+OBSERVABILITY_HANG_CAPTURE_S_DEFAULT = 1.0
+# report the capacity planner's predicted peak-HBM / boundary wire time
+# next to measurement in every window event (drift columns)
+OBSERVABILITY_PLANNER_DRIFT = "planner_drift"
+OBSERVABILITY_PLANNER_DRIFT_DEFAULT = True
+# fwd+bwd matmul FLOPs per sample (model-specific; bench.py's accounting)
+# — enables the per-window MFU column together with peak_tflops_per_chip
+OBSERVABILITY_FLOPS_PER_SAMPLE = "flops_per_sample"
+OBSERVABILITY_FLOPS_PER_SAMPLE_DEFAULT = None
+OBSERVABILITY_PEAK_TFLOPS = "peak_tflops_per_chip"
+OBSERVABILITY_PEAK_TFLOPS_DEFAULT = None
+
+#############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming
 # restore — checkpoint.py, docs/resilience.md "Time to resume".  No
 # reference analog: v0.1.0 saves/loads synchronously through torch.save.)

@@ -42,6 +42,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import analysis as graph_lint
 from deepspeed_tpu import constants as C
+from deepspeed_tpu.observability import fences as obs_fences
+from deepspeed_tpu.observability.tracing import annotate as _annotate
 from deepspeed_tpu import lr_schedules as schedules_mod
 from deepspeed_tpu import precision as prec
 from deepspeed_tpu import zero as zero_mod
@@ -795,6 +797,20 @@ class DeepSpeedTpuEngine:
         self._analysis_suppress = list(self.config.analysis_suppress)
         self._planned_keys = set()
 
+        # -- telemetry (docs/observability.md): spooled on-device metrics
+        #    (zero per-step host fences), programmatic step tracing, and the
+        #    unified exporter fan-out every scalar producer emits through.
+        #    Built LAST — it reads the summary writer, scheduler and
+        #    resilience wiring above.
+        from deepspeed_tpu.observability import Telemetry
+        self._telemetry = Telemetry.from_engine(self)
+        if self._watchdog is not None:
+            # a tripped hang deadline records a short trace before the
+            # optional abort (resilience/watchdog.py on_fire)
+            hook = self._telemetry.hang_capture_hook()
+            if hook is not None:
+                self._watchdog.on_fire = hook
+
         if self.config.dump_state:
             self.dump_state()
 
@@ -1160,10 +1176,32 @@ class DeepSpeedTpuEngine:
 
     def resilience_counters(self) -> dict:
         """Process-wide resilience counters (restarts, skipped-NaN steps,
-        IO retries, watchdog near-misses/fires) — also exported as
-        Train/Resilience/* TensorBoard scalars at every boundary."""
+        IO retries, watchdog near-misses/fires) — also exported through
+        the telemetry registry as Train/Resilience/* TensorBoard scalars
+        (per window when the metric spool is on, per boundary otherwise)."""
         from deepspeed_tpu.resilience import COUNTERS
         return COUNTERS.as_dict()
+
+    @property
+    def telemetry(self):
+        """The engine's :class:`~deepspeed_tpu.observability.Telemetry`
+        (always present; spool/tracer active only when configured —
+        docs/observability.md)."""
+        return self._telemetry
+
+    @property
+    def _spool(self):
+        """The active MetricSpool, or None (observability.report_window
+        unset) — the gate every spooled code path checks."""
+        return self._telemetry.spool
+
+    def flush_telemetry(self):
+        """Synchronously drain the final (possibly partial) metric window
+        — THE one deliberate telemetry fence.  Called by the resilience
+        driver on a preemption drain, at run completion, and before a
+        checkpoint restore, so no window is ever dropped or mixed across
+        a restore; safe to call any time (idempotent)."""
+        self._telemetry.flush()
 
     # ------------------------------------------------------------- data layer
 
@@ -1559,7 +1597,12 @@ class DeepSpeedTpuEngine:
             return
         self._planned_keys.add((kind, key))
         try:
-            rep = run().to_report(subject=kind)
+            plan = run()
+            if kind == "train_batch":
+                # planner handoff: the telemetry drift columns reuse THIS
+                # plan instead of re-tracing the fused program
+                self._telemetry.note_fused_plan(plan)
+            rep = plan.to_report(subject=kind)
         except Exception as e:  # pragma: no cover - defensive
             logger.warning("capacity plan could not analyze %s: %s",
                            kind, e)
@@ -1705,7 +1748,8 @@ class DeepSpeedTpuEngine:
             self._maybe_capacity_plan(
                 "eval", key,
                 lambda: self.plan_capacity(batch, train=False))
-            loss = self._eval_fn(self.params, batch)
+            with _annotate("eval"):
+                loss = self._eval_fn(self.params, batch)
             self._last_loss = loss
             if wcb:
                 self.timers(FORWARD_TIMER).stop(sync_on=loss)
@@ -1740,7 +1784,7 @@ class DeepSpeedTpuEngine:
             # step; reference's backward_inner span = the model bwd compute)
             if wcb:
                 self.timers(BACKWARD_INNER_TIMER).start()
-            with self._armed("backward (fused fwd+bwd)"):
+            with self._armed("backward (fused fwd+bwd)"), _annotate("fwdbwd"):
                 self._pending.force()
             if wcb:
                 self.timers(BACKWARD_INNER_TIMER).stop(
@@ -1750,9 +1794,13 @@ class DeepSpeedTpuEngine:
         if self.summary_writer is not None and self.is_gradient_accumulation_boundary():
             self.sample_count = (self.train_micro_batch_size_per_gpu()
                                  * self.dp_world_size * (self.micro_steps + 1))
-            if self._last_loss is not None:
+            if self._last_loss is not None and self._spool is None:
+                # float(l) is a host fence; with the metric spool on the
+                # loss rides the device ring buffer and reaches
+                # TensorBoard at the window drain instead
                 scalar = sum(float(l) for l in
                              jax.tree_util.tree_leaves(self._last_loss))
+                obs_fences.count_fence()
                 self.summary_writer.add_scalar("Train/Samples/train_loss",
                                                scalar, self.sample_count)
 
@@ -2225,6 +2273,8 @@ class DeepSpeedTpuEngine:
         path = output_path or self.config.profile_output_path
         jax.profiler.start_trace(path)
         self._profiling = True
+        from deepspeed_tpu.observability import tracing as obs_tracing
+        obs_tracing.note_capture_active(True)
         # flush the trace even if training ends inside the window; register
         # exactly once (a bound-method atexit handler pins the engine — one
         # is tolerable, one per start/stop cycle is a leak)
@@ -2237,6 +2287,8 @@ class DeepSpeedTpuEngine:
     def stop_profile(self):
         if not self._profiling:
             return
+        from deepspeed_tpu.observability import tracing as obs_tracing
+        obs_tracing.note_capture_active(False)
         jax.profiler.stop_trace()
         self._profiling = False
         logger.info("jax.profiler trace stopped")
@@ -2259,12 +2311,21 @@ class DeepSpeedTpuEngine:
         boundary update (reference deepspeed_light.py:723-788)."""
         self.global_steps += 1
         self._profile_window()
-        if self.config.fp16_enabled or self._nan_sentinel:
+        self._telemetry.maybe_trace(self.global_steps)
+        skip_contract = self.config.fp16_enabled or self._nan_sentinel
+        defer = (skip_contract
+                 and self._telemetry.defers_overflow(self))
+        if skip_contract and not defer:
             # host sync, boundary-only.  With the resilience NaN sentinel
             # the bf16/fp32 paths honour the same skip contract as fp16:
             # overflow => untouched master/moments, no scheduler step.
-            self.overflow = bool(overflow)
+            # With the metric spool on this read is DEFERRED to the window
+            # drain (the flag rides the ring buffer) — except under the
+            # scheduler exception defers_overflow documents.
+            self.overflow = bool(obs_fences.read_scalar(overflow))
         else:
+            # statically finite, or deferred: the drain settles
+            # skipped_steps/overflow retroactively (Telemetry._on_window)
             self.overflow = False
         if self.overflow:
             self.skipped_steps += 1
@@ -2281,22 +2342,21 @@ class DeepSpeedTpuEngine:
                     "optimizer boundary skipped (nan_sentinel)",
                     self.global_steps)
         elif self.lr_scheduler is not None:
+            # under deferral a skip contract never coexists with a
+            # scheduler (defers_overflow retains the read in that case),
+            # so stepping here is exactly the legacy semantics
             self.lr_scheduler.step()
 
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
 
         if self.summary_writer is not None:
-            lr_val = self.optimizer.param_groups[0]["lr"]
-            self.summary_writer.add_scalar(
-                "Train/Samples/lr", float(lr_val),
-                getattr(self, "sample_count", self.global_steps))
-            # degradation the resilience layer absorbed must stay
-            # observable, not silent (docs/resilience.md "Observability")
-            from deepspeed_tpu.resilience import COUNTERS
-            for name, val in COUNTERS.as_dict().items():
-                self.summary_writer.add_scalar(
-                    f"Train/Resilience/{name}", val,
+            if not self._telemetry.spool_active:
+                # legacy cadence: per-boundary scalars through the ONE
+                # registry (lr + resilience/compile-cache counters — the
+                # dedup of the three historical write loops).  With the
+                # spool on, export rides the window drain instead.
+                self._telemetry.emit_boundary_scalars(
                     getattr(self, "sample_count", self.global_steps))
 
     def _current_hypers(self):
@@ -2341,26 +2401,43 @@ class DeepSpeedTpuEngine:
             self._force_live_pendings()  # about to mutate params
             if self._step_fn is None:
                 self._step_fn = self._build_step()
-            master = self.master_flat if self.zero_flat else self.master
             # armed through the boundary's host sync (the overflow read in
             # bookkeeping): a hung boundary collective surfaces there, not
             # at the async dispatch
-            with self._armed("optimizer boundary step"):
+            with self._armed("optimizer boundary step"), \
+                    _annotate("boundary"):
                 from deepspeed_tpu.resilience import chaos as _chaos
                 _chaos.maybe_stall(self.global_steps)
+                spool = self._spool
+                if spool is not None:
+                    # the step program DONATES loss_scale_state; copy the
+                    # scale in effect for this boundary before dispatch so
+                    # the spool can record it (device copy — no fence)
+                    ls_scale_used = jnp.array(
+                        self.loss_scale_state.cur_scale, copy=True)
                 (self.params, new_master, self.opt_state,
                  self.loss_scale_state, overflow,
                  self._last_grad_norm) = self._step_fn(
-                    master, self.opt_state, self._acc, self.loss_scale_state,
-                    self._current_hypers(), self._zero_norm_w,
-                    self._zero_gid_flat)
+                    *graph_lint.step_args(self, self._acc))
                 if self.zero_flat:
                     self.master_flat = new_master
                 else:
                     self.master = new_master
                 self._acc = None
+                if spool is not None:
+                    # split-API spool append: one tiny jitted program per
+                    # boundary (the fused path folds this into
+                    # train_batch itself) — still zero fences
+                    self._telemetry.note_spool_base_step(self.global_steps)
+                    spool.append_split(
+                        self._last_loss if self._last_loss is not None
+                        else jnp.zeros((), jnp.float32),
+                        self._last_grad_norm, ls_scale_used, overflow)
                 self._post_boundary_bookkeeping(overflow)
-                self.tput_timer.stop(sync_on=self.params)
+                if spool is not None:
+                    self.tput_timer.stop(report_speed=False, sync_on=None)
+                else:
+                    self.tput_timer.stop(sync_on=self.params)
 
         self.micro_steps += 1
         if wcb:
@@ -2451,11 +2528,34 @@ class DeepSpeedTpuEngine:
             out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
                        P(), P(), P()),
             check_vma=False)
+        if self._spool is not None:
+            # MetricSpool: append this boundary's (loss, grad norm, loss
+            # scale, skip flag) into the device ring buffer INSIDE the
+            # compiled step — pure consumers of values the program already
+            # computes, so the optimizer math is bitwise identical with
+            # the spool off (docs/observability.md; pinned by
+            # tests/test_observability.py).  The buffer stays on device;
+            # one batched callback per report window drains it.
+            from deepspeed_tpu.observability import spool as spool_mod
+            shard_fn = fn
+
+            def fn(params, master, opt_state, ls_state, hypers, normw,
+                   gids, batch_args, spool_state):
+                outs = shard_fn(params, master, opt_state, ls_state,
+                                hypers, normw, gids, batch_args)
+                (_, _, _, _, overflow, total_norm, last_loss) = outs
+                new_spool = spool_mod.append(
+                    spool_state, last_loss, total_norm,
+                    ls_state.cur_scale, overflow)
+                return outs + (new_spool,)
+
         # donate params/master/opt-state/loss-scale (all replaced by outputs).
         # In fp32 mode params.astype(fp32) is an identity, so XLA aliases the
         # output params and master buffers — donating either on the next call
         # would donate a buffer that is also passed as the other argument;
-        # donate only the optimizer/loss-scale state there.
+        # donate only the optimizer/loss-scale state there.  (The spool
+        # state is NOT donated: the ring is tiny and an in-flight drain
+        # callback still reads the previous buffer.)
         return jax.jit(fn, donate_argnums=self._donate_argnums(fused=True))
 
     def train_batch(self, batch):
@@ -2494,25 +2594,46 @@ class DeepSpeedTpuEngine:
         self._maybe_capacity_plan(
             "train_batch", key,
             lambda: self.plan_capacity(batch, train=True, fused=True))
-        master = self.master_flat if self.zero_flat else self.master
+        spool = self._spool
+        if spool is not None:
+            self._telemetry.note_spool_base_step(self.global_steps)
+            self._telemetry.note_predictions(self, batch)
+            self._maybe_graph_lint(
+                "spool_drain", "spool",
+                lambda: graph_lint.analyze_jaxpr(
+                    jax.make_jaxpr(spool.drain_program())(spool.state),
+                    subject="spool_drain"))
+        # call tuple via the single protocol owner (analysis.train_batch
+        # _args appends the spool state when the spool is on)
+        args = graph_lint.train_batch_args(self, batch)
         # armed through the boundary's host sync (see step()): a hung
         # collective inside the fused program surfaces at the overflow
         # read / loss sync, not at the async dispatch
-        with self._armed("train_batch"):
+        with self._armed("train_batch"), _annotate("train_batch"):
             from deepspeed_tpu.resilience import chaos as _chaos
             _chaos.maybe_stall(self.global_steps)
+            outs = self._train_batch_fn(*args)
+            if spool is not None:
+                outs, new_spool = outs[:-1], outs[-1]
             (self.params, new_master, self.opt_state, self.loss_scale_state,
-             overflow, self._last_grad_norm, loss) = self._train_batch_fn(
-                self.params, master, self.opt_state, self.loss_scale_state,
-                self._current_hypers(), self._zero_norm_w,
-                self._zero_gid_flat, batch)
+             overflow, self._last_grad_norm, loss) = outs
             if self.zero_flat:
                 self.master_flat = new_master
             else:
                 self.master = new_master
             self.micro_steps += gas
+            if spool is not None:
+                # adopt the ring state (auto-drains on window edges — one
+                # async batched callback, the host never waits)
+                spool.note_append(new_spool)
             self._post_boundary_bookkeeping(overflow)
-            self.tput_timer.stop(sync_on=loss)
+            if spool is not None:
+                # throughput/goodput ride the window drain timestamps;
+                # fencing (and printing dispatch-rate numbers) here would
+                # reintroduce the per-report-step stall the spool removes
+                self.tput_timer.stop(report_speed=False, sync_on=None)
+            else:
+                self.tput_timer.stop(sync_on=loss)
         return loss
 
     # ------------------------------------------------------------- reporting
@@ -2540,7 +2661,7 @@ class DeepSpeedTpuEngine:
         # the save stall is not training throughput: keep it out of the
         # next report window (timer.py window accounting)
         self.tput_timer.discard_window()
-        with self._armed("save_checkpoint"):
+        with self._armed("save_checkpoint"), _annotate("checkpoint.save"):
             return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
                                             client_state=client_state,
                                             async_save=async_save)
@@ -2556,12 +2677,17 @@ class DeepSpeedTpuEngine:
         """reference deepspeed_light.py:974-1046; returns (path,
         client_state)."""
         self._force_live_pendings()  # deferred forwards saw the old params
+        # drain the undelivered metric window NOW, labeled with the
+        # PRE-restore step numbers: stale ring rows must never mix into a
+        # post-restore window (and deferred skip bookkeeping must not
+        # land on the restored trajectory)
+        self.flush_telemetry()
         import time as _time
 
         from deepspeed_tpu import checkpoint as ckpt_mod
         from deepspeed_tpu.resilience import COUNTERS
         t0 = _time.perf_counter()
-        with self._armed("load_checkpoint"):
+        with self._armed("load_checkpoint"), _annotate("checkpoint.load"):
             path, client = ckpt_mod.load_checkpoint(
                 self, load_dir, tag=tag,
                 load_optimizer_states=load_optimizer_states,
@@ -2570,6 +2696,9 @@ class DeepSpeedTpuEngine:
             # restore sits on the preemption-resume critical path: keep its
             # latency observable (Train/Resilience/restore_seconds)
             COUNTERS.restore_seconds = _time.perf_counter() - t0
+            # window step numbering follows the restored step count (the
+            # pre-restore partial window was flushed above)
+            self._telemetry.rebase_steps(self.global_steps)
         return path, client
 
     # ------------------------------------------------- optimizer state (ckpt)

@@ -34,6 +34,7 @@ import sys
 import time
 
 from deepspeed_tpu.launcher.run import decode_world_info
+from deepspeed_tpu.observability.tracing import ENV_TRACE_DIR
 from deepspeed_tpu.resilience import RESTARTABLE_EXIT_CODES
 from deepspeed_tpu.utils.compile_cache import ENV_DIR as COMPILE_CACHE_ENV_DIR
 
@@ -66,6 +67,12 @@ def parse_args(args=None):
                              "DSTPU_COMPILE_CACHE_DIR so time-to-first-step "
                              "after a preemption is restore + cache read, "
                              "not restore + full recompile")
+    parser.add_argument("--trace_dir", type=str, default="",
+                        help="Telemetry trace destination exported to "
+                             "every spawned worker (including relaunches) "
+                             "as DSTPU_TRACE_DIR — the engine resolves it "
+                             "when the config carries no "
+                             "observability.trace_dir")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -110,6 +117,10 @@ def _spawn_procs(args, local_ranks, world_size, node_host):
             # fallback (utils/compile_cache.resolve_dir) picks it up even
             # when the ds_config carries no compile_cache block
             env[COMPILE_CACHE_ENV_DIR] = args.compile_cache_dir
+        if args.trace_dir:
+            # same fallback pattern for trace captures (workers append a
+            # per-process subdirectory — observability/tracing.py)
+            env[ENV_TRACE_DIR] = args.trace_dir
         cmd = ([sys.executable, "-u", args.training_script]
                + args.training_script_args
                + [f"--local_rank={local_rank}"])

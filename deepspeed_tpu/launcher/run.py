@@ -76,6 +76,13 @@ def parse_args(args=None):
                              "DSTPU_COMPILE_CACHE_DIR, so a restarted "
                              "process reuses the prior attempt's compiled "
                              "step programs (docs/resilience.md)")
+    parser.add_argument("--trace_dir", type=str, default="",
+                        help="Telemetry trace destination exported to "
+                             "every worker (and every --max_restarts "
+                             "relaunch) as DSTPU_TRACE_DIR: jax.profiler "
+                             "capture windows and watchdog hang captures "
+                             "land here, one subdirectory per process "
+                             "(docs/observability.md)")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat a single-node pool as multi-node (ssh)")
     parser.add_argument("user_script", type=str,
@@ -271,6 +278,8 @@ def main(args=None):
                        f"--restart_backoff={args.restart_backoff}"]
     if args.compile_cache_dir:
         launch_cmd += [f"--compile_cache_dir={args.compile_cache_dir}"]
+    if args.trace_dir:
+        launch_cmd += [f"--trace_dir={args.trace_dir}"]
 
     if not multi_node:
         cmd = launch_cmd + ["--node_rank=0", args.user_script] + args.user_args

@@ -1,0 +1,360 @@
+"""Telemetry — the engine's single observability layer (docs/observability.md).
+
+Four pieces, one facade:
+
+* :mod:`~deepspeed_tpu.observability.spool` — MetricSpool: per-boundary
+  loss/grad-norm/loss-scale/skip-flag accumulated in a device-side ring
+  buffer inside the compiled step, drained by ONE batched host callback
+  every ``report_window`` boundaries.  Replaces every per-step host fence
+  (the ROADMAP-4 prerequisite); trajectory-neutral by construction.
+* :mod:`~deepspeed_tpu.observability.tracing` — programmatic
+  ``jax.profiler`` capture over a configured step window, ``dstpu/*``
+  TraceAnnotation spans, and watchdog-triggered hang capture.
+* :mod:`~deepspeed_tpu.observability.registry` — MetricRegistry exporter
+  fan-out: engine throughput/goodput, resilience counters and
+  compile-cache counters all emit through one path to TensorBoard and a
+  schema-versioned JSONL event log (:mod:`~.schema`).
+* goodput accounting — per-window measured step time, samples/s, optional
+  MFU, and measured-vs-predicted capacity (the PR 6 planner handoff) with
+  ``drift`` ratios, so prediction rot is a column, not a surprise.
+
+Config::
+
+    "observability": {
+      "report_window": 0,          # >= 1 enables the spool
+      "jsonl_path": null,          # JSONL event log (process 0)
+      "trace_dir": null,           # or env DSTPU_TRACE_DIR (dst --trace_dir)
+      "trace_start_step": 10,
+      "trace_num_steps": 0,        # > 0 schedules a capture window
+      "hang_capture": true,        # watchdog fire -> trace under trace_dir
+      "hang_capture_s": 1.0,
+      "planner_drift": true,       # predicted peak-HBM/boundary columns
+      "flops_per_sample": null,    # enables the MFU column
+      "peak_tflops_per_chip": null
+    }
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.observability import fences  # noqa: F401  (re-export)
+from deepspeed_tpu.observability import schema  # noqa: F401
+from deepspeed_tpu.observability import spool as spool_mod
+from deepspeed_tpu.observability import tracing
+from deepspeed_tpu.observability.registry import (JsonlSink, MetricRegistry,
+                                                  TensorboardSink)
+from deepspeed_tpu.observability.spool import MetricSpool
+from deepspeed_tpu.observability.tracing import Tracer, annotate
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Telemetry", "MetricSpool", "MetricRegistry", "TensorboardSink",
+    "JsonlSink", "Tracer", "annotate", "fences", "schema", "spool_mod",
+    "tracing",
+]
+
+
+class Telemetry:
+    """Per-engine telemetry driver.  Built by the engine at the end of
+    ``__init__`` (after the summary writer and scheduler exist); holds the
+    engine by weakref — the drain callback must never keep a dead engine
+    alive."""
+
+    def __init__(self, engine):
+        import jax
+        cfg = engine.config
+        self._engine_ref = weakref.ref(engine)
+        self.window = int(cfg.observability_report_window)
+        self.registry = MetricRegistry()
+        self._lock = threading.Lock()
+        self._last_drain_ts = None      # set at first drain; window 1 is
+        self._base_step = None          # unmeasured (it includes compile)
+        self._skip_contract = bool(cfg.fp16_enabled
+                                   or cfg.resilience_nan_sentinel)
+        self._fp16 = bool(cfg.fp16_enabled)
+        self._sentinel = bool(cfg.resilience_nan_sentinel)
+        self._defer_overflow = None     # resolved lazily (needs scheduler)
+        self._warned_sync_exception = False
+        self.predictions = {}           # planner handoff (note_predictions)
+        self._predictions_tried = False
+        self.planner_drift = bool(cfg.observability_planner_drift)
+        self.flops_per_sample = cfg.observability_flops_per_sample
+        self.peak_tflops = cfg.observability_peak_tflops_per_chip
+        self.measured_boundary_ms = None    # set by whoever measures it
+        self.samples_per_step = (cfg.train_batch_size or 0)
+        self._n_devices = jax.device_count()
+
+        # sinks: TensorBoard rides the engine's writer, resolved LIVE at
+        # emit time (rank-0 gated there; tests and users may swap the
+        # writer after build); the JSONL event log writes on process 0
+        self._tb = TensorboardSink(self._live_writer)
+        self.registry.add_sink(self._tb)
+        self.jsonl_path = None
+        if (cfg.observability_jsonl_path
+                and jax.process_index() == 0):
+            self.jsonl_path = cfg.observability_jsonl_path
+            self.registry.add_sink(JsonlSink(self.jsonl_path))
+
+        # sources: the deduped scalar producers (legacy tag spellings kept:
+        # Train/Samples/lr, Train/Resilience/*)
+        from deepspeed_tpu.resilience import COUNTERS
+        self.registry.register("resilience", COUNTERS.as_dict)
+        self.registry.register("samples", self._samples_source)
+
+        # spool (report_window >= 1)
+        self.spool: Optional[MetricSpool] = None
+        if self.window >= 1:
+            self.spool = MetricSpool(self.window, self._on_window)
+            # resolve the deferral decision NOW (the scheduler exists —
+            # the engine builds Telemetry last): at report_window=1 the
+            # first drain can run before any boundary bookkeeping, and a
+            # lazily-unresolved flag would silently skip that window's
+            # deferred skip accounting
+            self.defers_overflow(engine)
+
+        # tracer (trace_dir from config or DSTPU_TRACE_DIR)
+        self.tracer: Optional[Tracer] = None
+        trace_dir = tracing.resolve_trace_dir(cfg.observability_trace_dir)
+        if trace_dir is not None:
+            self.tracer = Tracer(
+                trace_dir,
+                start_step=cfg.observability_trace_start_step,
+                num_steps=cfg.observability_trace_num_steps,
+                hang_capture_s=cfg.observability_hang_capture_s)
+        self.hang_capture = bool(cfg.observability_hang_capture)
+
+    @classmethod
+    def from_engine(cls, engine) -> "Telemetry":
+        """Every engine gets a Telemetry: with no ``observability`` config
+        the spool/tracer stay off, but the registry still owns ALL scalar
+        export (the dedup of the three legacy TensorBoard write loops —
+        one path whether metrics ride windows or boundaries)."""
+        return cls(engine)
+
+    # ------------------------------------------------------------- sources
+    def _live_writer(self):
+        engine = self._engine_ref()
+        return engine.summary_writer if engine is not None else None
+
+    def _samples_source(self) -> dict:
+        engine = self._engine_ref()
+        if engine is None:
+            return {}
+        return {"lr": float(engine.optimizer.param_groups[0]["lr"])}
+
+    # --------------------------------------------------------------- spool
+    @property
+    def spool_active(self) -> bool:
+        return self.spool is not None
+
+    def defers_overflow(self, engine) -> bool:
+        """Whether the engine may SKIP the per-boundary overflow host read
+        (the last per-step fence).  True whenever the spool is on — except
+        under the documented exception: fp16/nan-sentinel WITH an LR
+        scheduler, whose skip-on-overflow contract (no scheduler step on a
+        skipped boundary) needs the flag on the host before the next
+        boundary's hyperparameter staging.  There the read stays and the
+        spool still batches every other metric."""
+        if self.spool is None:
+            return False
+        if self._defer_overflow is None:
+            exception = (self._skip_contract
+                         and engine.lr_scheduler is not None)
+            self._defer_overflow = not exception
+            if exception and not self._warned_sync_exception:
+                self._warned_sync_exception = True
+                logger.warning(
+                    "telemetry: per-boundary overflow read RETAINED — the "
+                    "%s skip contract must gate lr_scheduler.step() before "
+                    "the next boundary (docs/observability.md \"The "
+                    "scheduler exception\"); all other metrics still spool",
+                    "fp16" if self._fp16 else "nan_sentinel")
+        return self._defer_overflow
+
+    def note_fused_plan(self, plan) -> None:
+        """Adopt a capacity plan the engine's build-time gate already
+        computed (engine._maybe_capacity_plan) — the drift columns must
+        not re-trace the fused program to learn a number that exists."""
+        if self.planner_drift and "predicted_peak_hbm_gb" not in \
+                self.predictions:
+            self.predictions["predicted_peak_hbm_gb"] = round(
+                plan.peak_bytes / 2 ** 30, 6)
+            if plan.profile is not None:
+                self.predictions.setdefault("predicted_profile",
+                                            plan.profile.name)
+
+    def note_predictions(self, engine, batch) -> None:
+        """One-time planner handoff (best-effort): predicted per-device
+        peak HBM of the fused program (reused from the analysis gate's
+        plan when it ran — see :meth:`note_fused_plan`) + predicted
+        boundary wire time from the split-API plan, reported next to
+        measurement in every window event (``*_drift`` columns)."""
+        if self._predictions_tried or not self.planner_drift:
+            return
+        self._predictions_tried = True
+        # defensive batch normalization: the engine hands the tuple form,
+        # but a bare-array batch must not silently cost the drift columns
+        batch = (tuple(batch) if isinstance(batch, (tuple, list))
+                 else (batch,))
+        try:
+            if "predicted_peak_hbm_gb" not in self.predictions:
+                fused = engine.plan_capacity(batch, train=True, fused=True)
+                self.predictions["predicted_peak_hbm_gb"] = round(
+                    fused.peak_bytes / 2 ** 30, 6)
+            gas = engine.gradient_accumulation_steps()
+            lead = next(iter(
+                l.shape[0] for l in _tree_leaves(batch)))
+            micro = tuple(a[:lead // gas] for a in batch)
+            split = engine.plan_capacity(micro, train=True, fused=False)
+            if split.boundary_comm is not None:
+                self.predictions["predicted_boundary_ms"] = round(
+                    split.boundary_comm.predicted_time_ms(), 6)
+                if split.profile is not None:
+                    self.predictions.setdefault("predicted_profile",
+                                                split.profile.name)
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning("telemetry: capacity-plan handoff skipped: %s", e)
+
+    def _on_window(self, rows: np.ndarray, pos: int) -> None:
+        """Spool delivery (runtime callback thread on async drains, caller
+        thread on flush): aggregate the window, settle the deferred
+        skip bookkeeping, emit through the registry."""
+        n = int(rows.shape[0])
+        now = time.time()
+        engine = self._engine_ref()
+        with self._lock:
+            base = self._base_step or 0
+            last_ts, self._last_drain_ts = self._last_drain_ts, now
+        step = base + pos
+
+        skips = int(np.sum(rows[:, spool_mod.SKIP] > 0)) \
+            if self._skip_contract else 0
+        if engine is not None and self._defer_overflow:
+            # deferred skip-on-overflow bookkeeping (the host read this
+            # replaces): counters catch up at the drain, the device-side
+            # skip (untouched master/moments) already happened in-program
+            engine.skipped_steps += skips
+            engine.overflow = bool(rows[-1, spool_mod.SKIP] > 0)
+            if skips and self._sentinel and not self._fp16:
+                from deepspeed_tpu.resilience import COUNTERS
+                COUNTERS.nan_skips += skips
+                logger.warning(
+                    "resilience: %d non-finite-gradient boundar%s skipped "
+                    "in the window ending at global step %d (nan_sentinel, "
+                    "spooled)", skips, "y" if skips == 1 else "ies", step)
+
+        event = {
+            "step": int(step),
+            "window_steps": n,
+            "loss": float(rows[-1, spool_mod.LOSS]),
+            "loss_mean": float(np.mean(rows[:, spool_mod.LOSS])),
+            "grad_norm": float(rows[-1, spool_mod.GRAD_NORM]),
+            "loss_scale": float(rows[-1, spool_mod.LOSS_SCALE]),
+            "skipped": skips,
+            "ts": now,
+        }
+        if last_ts is not None and now > last_ts:
+            elapsed = now - last_ts
+            event["step_ms"] = elapsed / n * 1000.0
+            if self.samples_per_step:
+                sps = n * self.samples_per_step / elapsed
+                event["samples_per_sec"] = sps
+                if self.flops_per_sample and self.peak_tflops:
+                    event["mfu"] = (
+                        (sps / self._n_devices)
+                        * float(self.flops_per_sample)
+                        / (float(self.peak_tflops) * 1e12))
+        event.update(self._capacity_columns())
+        sample_count = (getattr(engine, "sample_count", None)
+                        if engine is not None else None)
+        self.registry.emit(event, sample_count=sample_count)
+
+    def _capacity_columns(self) -> dict:
+        """Measured-vs-predicted capacity (PR 6 planner handoff)."""
+        out = dict(self.predictions)
+        measured = _measured_peak_hbm_gb()
+        if measured is not None:
+            out["measured_peak_hbm_gb"] = round(measured, 4)
+            pred = out.get("predicted_peak_hbm_gb")
+            if pred:
+                out["hbm_drift"] = round(measured / pred, 4)
+        if self.measured_boundary_ms is not None:
+            out["measured_boundary_ms"] = round(self.measured_boundary_ms, 4)
+            pred = out.get("predicted_boundary_ms")
+            if pred:
+                out["boundary_drift"] = round(
+                    self.measured_boundary_ms / pred, 4)
+        return out
+
+    # --------------------------------------------------- engine-facing hooks
+    def note_spool_base_step(self, global_steps: int) -> None:
+        """Anchor ring positions to engine global steps (set at the first
+        spooled boundary; a resumed engine anchors at its restored step)."""
+        with self._lock:
+            if self._base_step is None:
+                self._base_step = int(global_steps)
+
+    def rebase_steps(self, global_steps: int) -> None:
+        """Re-anchor window step numbering after a checkpoint restore:
+        subsequent events report ``restored step + appends since``."""
+        if self.spool is None:
+            return
+        with self._lock:
+            self._base_step = int(global_steps) - self.spool._appended
+
+    def emit_boundary_scalars(self, sample_count) -> None:
+        """Legacy-cadence TensorBoard export (spool OFF): the same source
+        snapshot the window path emits, written per boundary through the
+        ONE TensorBoard sink — the dedup of the three historical write
+        loops, and one owner of the tag spelling (a counters-only event
+        writes no ``Train/Telemetry/*`` window scalars)."""
+        self._tb.emit({"step": sample_count,
+                       "counters": self.registry.counters_snapshot()},
+                      sample_count=sample_count)
+
+    def maybe_trace(self, global_steps: int) -> None:
+        if self.tracer is not None:
+            self.tracer.maybe_window(global_steps)
+
+    def hang_capture_hook(self):
+        """The watchdog ``on_fire`` callable (None when tracing is off)."""
+        if self.tracer is None or not self.hang_capture:
+            return None
+        return lambda: self.tracer.capture_hang()
+
+    def flush(self) -> None:
+        """Drain the final (possibly partial) window synchronously — run
+        end and preemption drain; the ONE deliberate telemetry fence."""
+        if self.spool is not None:
+            self.spool.flush()
+
+    def close(self) -> None:
+        self.flush()
+        if self.tracer is not None:
+            self.tracer.stop()
+        self.registry.close()
+
+
+def _tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _measured_peak_hbm_gb() -> Optional[float]:
+    """Per-device peak HBM from the PJRT allocator (None on backends
+    without memory stats — CPU)."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # pragma: no cover - defensive
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return None if peak is None else peak / 2 ** 30

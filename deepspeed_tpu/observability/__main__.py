@@ -1,0 +1,43 @@
+"""JSONL event-log validator CLI.
+
+``python -m deepspeed_tpu.observability <events.jsonl> [...]`` — validates
+every line of each telemetry event log against the window schema
+(observability/schema.py).  Exit codes: 0 = every file valid and
+non-empty, 2 = any problem (the CI observability smoke job's gate).
+Needs no jax — it is a pure-JSON check usable on artifact files anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from deepspeed_tpu.observability import schema
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.observability",
+        description="Validate telemetry JSONL event logs "
+                    "(schema %s v%d)" % (schema.SCHEMA_ID,
+                                         schema.SCHEMA_VERSION))
+    parser.add_argument("paths", nargs="+", help="JSONL event log(s)")
+    args = parser.parse_args(argv)
+
+    rc = 0
+    for path in args.paths:
+        problems = schema.validate_jsonl(path)
+        if not problems:
+            with open(path) as f:
+                n = sum(1 for line in f if line.strip())
+            print(f"{path}: OK ({n} event(s))")
+            continue
+        rc = 2
+        for line_no, msg in problems:
+            where = f"{path}:{line_no}" if line_no else path
+            print(f"{where}: {msg}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

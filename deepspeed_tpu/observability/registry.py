@@ -1,0 +1,185 @@
+"""MetricRegistry — the single exporter fan-out.
+
+Before this layer the engine had three independent scalar-writing paths
+(throughput logging, ``resilience/counters.py`` TensorBoard loops, the
+compile-cache counters riding the same loop) and nothing machine-readable.
+Now every producer registers a SOURCE — a callable returning
+``{name: number}`` — and the registry emits one consistent snapshot per
+report window to every attached SINK:
+
+* :class:`TensorboardSink` — ``Train/<group>/<name>`` scalars through the
+  engine's existing ``SummaryWriter`` (same tags the three legacy paths
+  wrote, so dashboards keep working);
+* :class:`JsonlSink` — one schema-versioned line per window
+  (observability/schema.py), the artifact the CI smoke job validates and
+  bench tooling diffs.
+
+Sources are pulled at EMIT time (drain or boundary), never per step —
+collection cost rides the report cadence, not the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.observability import schema
+
+logger = logging.getLogger(__name__)
+
+
+class MetricRegistry:
+    """Named metric sources fanned out to sinks (thread-safe: the spool
+    drain callback runs on the runtime's callback thread)."""
+
+    def __init__(self):
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._sinks = []
+        self._lock = threading.Lock()
+
+    def register(self, group: str, source: Callable[[], dict]) -> None:
+        """Register/replace the source for ``group`` (a callable returning
+        a flat ``{name: number}`` dict, pulled at emit time)."""
+        with self._lock:
+            self._sources[group] = source
+
+    def unregister(self, group: str) -> None:
+        with self._lock:
+            self._sources.pop(group, None)
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def collect(self) -> Dict[str, dict]:
+        """One snapshot of every source: ``{group: {name: value}}``.  A
+        source that raises is skipped with a warning — observability must
+        never take down training."""
+        with self._lock:
+            sources = dict(self._sources)
+        out = {}
+        for group, fn in sources.items():
+            try:
+                out[group] = dict(fn())
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("telemetry source %r failed: %s", group, e)
+        return out
+
+    def counters_snapshot(self) -> dict:
+        """Every source flattened to ``{"group/name": value}`` — the
+        counter spelling both export cadences (window drain and legacy
+        boundary) share."""
+        out = {}
+        for group, vals in self.collect().items():
+            for name, val in vals.items():
+                out[f"{group}/{name}"] = val
+        return out
+
+    def emit(self, event: dict, sample_count: Optional[int] = None) -> None:
+        """Fan one window event (plus a fresh source snapshot) out to every
+        sink.  ``event`` is the spool's window record; sinks receive it
+        with ``counters`` filled from the collected snapshot."""
+        event = dict(event)
+        event.setdefault("counters", {}).update(self.counters_snapshot())
+        with self._lock:
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink.emit(event, sample_count=sample_count)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("telemetry sink %r failed: %s",
+                               type(sink).__name__, e)
+
+    def close(self) -> None:
+        with self._lock:
+            sinks, self._sinks = list(self._sinks), []
+        for sink in sinks:
+            try:
+                sink.close()
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+
+class TensorboardSink:
+    """Window events as ``Train/*`` scalars through an existing
+    SummaryWriter — the dedup target of the three legacy write loops.
+    Scalar tags: window metrics under ``Train/Telemetry/*``, counter
+    groups under ``Train/<Group>/<name>`` (``Train/Resilience/*`` keeps
+    its PR 4/5 spelling, so existing dashboards keep working)."""
+
+    #: window-event fields exported as Train/Telemetry/* scalars
+    _WINDOW_FIELDS = ("loss", "loss_mean", "grad_norm", "loss_scale",
+                      "skipped", "step_ms", "samples_per_sec", "mfu",
+                      "measured_peak_hbm_gb", "hbm_drift",
+                      "predicted_peak_hbm_gb", "predicted_boundary_ms",
+                      "measured_boundary_ms", "boundary_drift")
+
+    def __init__(self, writer):
+        #: a SummaryWriter, or a zero-arg callable resolving one LIVE —
+        #: the engine's writer may be replaced after construction (tests
+        #: inject fakes; users wire writers late), so the sink must not
+        #: capture a stale reference
+        self._writer = writer
+
+    @property
+    def writer(self):
+        w = self._writer
+        return w() if callable(w) else w
+
+    def emit(self, event: dict, sample_count: Optional[int] = None) -> None:
+        writer = self.writer
+        if writer is None:
+            return
+        x = sample_count if sample_count is not None else event["step"]
+        for name in self._WINDOW_FIELDS:
+            val = event.get(name)
+            if val is not None:
+                writer.add_scalar(f"Train/Telemetry/{name}",
+                                  float(val), x)
+        for key, val in event.get("counters", {}).items():
+            group, _, name = key.partition("/")
+            writer.add_scalar(
+                f"Train/{group.capitalize()}/{name}", float(val), x)
+
+    def close(self) -> None:
+        pass        # the writer belongs to the engine
+
+
+class JsonlSink:
+    """One schema-stamped JSON line per window, flushed per emit (the file
+    must be complete up to the last drained window when the process is
+    preempted — the flush-on-drain contract the resilience driver relies
+    on).  Lines that fail self-validation are still written but logged
+    loudly: a schema bug must be visible in CI, not silently dropped."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+
+    def emit(self, event: dict, sample_count: Optional[int] = None) -> None:
+        event = dict(event)
+        event["schema"] = schema.SCHEMA_ID
+        event["version"] = schema.SCHEMA_VERSION
+        event.setdefault("ts", time.time())
+        # every schema field present (null when unmeasured): a missing
+        # column and an unmeasured column are different facts
+        for name in schema.FIELDS:
+            event.setdefault(name, None)
+        msg = schema.validate_event(event)
+        if msg is not None:  # pragma: no cover - schema bug guard
+            logger.error("telemetry event fails its own schema (%s): %r",
+                         msg, event)
+        self._f.write(json.dumps(event) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:  # pragma: no cover - defensive
+            pass

@@ -1,0 +1,127 @@
+"""Machine-readable telemetry event schema (one JSONL line per window).
+
+The JSONL event log is the machine half of the exporter fan-out
+(TensorBoard is the human half): one line per drained report window,
+schema-versioned so downstream tooling (bench diffing, fleet dashboards,
+the CI smoke gate) can parse it without guessing.  Validation is
+hand-rolled — no jsonschema dependency — and doubles as the documentation
+of record for every field (docs/observability.md mirrors this table).
+
+Schema evolution contract: additive fields bump ``SCHEMA_VERSION`` minor
+semantics only (validators accept unknown EXTRA keys); removing or
+retyping a field is a breaking change and bumps the major version.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+from typing import Optional
+
+#: event-log schema identifier + version, stamped on every line
+SCHEMA_ID = "dstpu.telemetry.window"
+SCHEMA_VERSION = 1
+
+_NUM = numbers.Real
+
+#: field -> (type check, required).  Optional fields must still be PRESENT
+#: (null when unknown) — a missing column and an unmeasured column are
+#: different facts, and downstream diffing relies on a stable key set.
+FIELDS = {
+    "schema": (str, True),
+    "version": (int, True),
+    "ts": (_NUM, True),                 # unix seconds at drain
+    "step": (int, True),                # engine global_steps at window end
+    "window_steps": (int, True),        # boundaries in this window (>0)
+    "loss": (_NUM, False),              # last boundary's loss (sum of leaves)
+    "loss_mean": (_NUM, False),         # mean over the window
+    "grad_norm": (_NUM, False),         # last boundary's global grad norm
+    "loss_scale": (_NUM, False),        # loss scale in effect (fp16)
+    "skipped": (int, True),             # skip-on-overflow boundaries
+    "step_ms": (_NUM, False),           # measured mean step wall ms
+    "samples_per_sec": (_NUM, False),
+    "mfu": (_NUM, False),               # needs observability.flops_per_sample
+    # predicted-vs-measured capacity (PR 6 planner handoff): drift =
+    # measured / predicted, the number that makes prediction rot visible
+    "predicted_peak_hbm_gb": (_NUM, False),
+    "measured_peak_hbm_gb": (_NUM, False),
+    "hbm_drift": (_NUM, False),
+    "predicted_boundary_ms": (_NUM, False),
+    "measured_boundary_ms": (_NUM, False),
+    "boundary_drift": (_NUM, False),
+    # which BackendProfile priced the predictions: the planner defaults to
+    # the RUNNING backend (matching what `measured_*` sees), but a config
+    # `analysis.profile` overrides it — drift is only meaningful knowing
+    # which one applied
+    "predicted_profile": (str, False),
+    "counters": (dict, True),           # resilience/compile-cache counters
+}
+
+
+def validate_event(event: dict) -> Optional[str]:
+    """Return None when ``event`` is a valid window event, else a message
+    naming the first problem.  Unknown extra keys are allowed (additive
+    schema evolution); known keys must carry the declared type or null
+    (optional fields only)."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{SCHEMA_ID!r}")
+    if event.get("version") != SCHEMA_VERSION:
+        return (f"version is {event.get('version')!r}, expected "
+                f"{SCHEMA_VERSION}")
+    for name, (typ, required) in FIELDS.items():
+        if name not in event:
+            return f"missing field {name!r}"
+        val = event[name]
+        if val is None:
+            if required:
+                return f"required field {name!r} is null"
+            continue
+        if typ is int:
+            # bool is an int subclass; a true/false here is a bug
+            if not isinstance(val, int) or isinstance(val, bool):
+                return f"field {name!r} must be an integer, got {val!r}"
+        elif not isinstance(val, typ):
+            return (f"field {name!r} must be "
+                    f"{getattr(typ, '__name__', typ)}, got {val!r}")
+    if event["window_steps"] <= 0:
+        return f"window_steps must be > 0, got {event['window_steps']}"
+    if not (0 <= event["skipped"] <= event["window_steps"]):
+        return (f"skipped ({event['skipped']}) outside "
+                f"[0, window_steps={event['window_steps']}]")
+    for k, v in event["counters"].items():
+        if not isinstance(k, str) or (v is not None
+                                      and not isinstance(v, _NUM)):
+            return f"counters[{k!r}] must map str -> number, got {v!r}"
+    return None
+
+
+def validate_jsonl(path: str) -> list:
+    """Validate every line of a JSONL event log.  Returns a list of
+    ``(line_number, message)`` problems (empty = valid); an unreadable or
+    EMPTY file is a problem — the CI smoke gate treats "no telemetry" as
+    a failure, not a pass."""
+    problems = []
+    n = 0
+    try:
+        with open(path, "r") as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                n += 1
+                try:
+                    event = json.loads(line)
+                except ValueError as e:
+                    problems.append((i, f"not valid JSON: {e}"))
+                    continue
+                msg = validate_event(event)
+                if msg is not None:
+                    problems.append((i, msg))
+    except OSError as e:
+        return [(0, f"cannot read {path!r}: {e}")]
+    if n == 0:
+        problems.append((0, f"{path!r} contains no events"))
+    return problems

@@ -66,6 +66,9 @@ class _State:
         self.sigterm_rank = None    # ...only on this rank (None = every rank)
         self.stall_step = None      # stall at this step
         self.stall_s = 0.0          # ...for this long
+        self.stall_until = None     # ...or until this Event fires
+                                    # (programmatic-only: tests end the
+                                    # stall when the watchdog reacted)
         self.nan_step = None        # poison the batch at this step
 
 
@@ -86,14 +89,17 @@ def reload_env() -> None:
     _state.sigterm_rank = _env_int(ENV_CHAOS_RANK)
     _state.stall_step = _env_int(ENV_STALL_STEP)
     _state.stall_s = float(os.environ.get(ENV_STALL_S, "0") or 0)
+    _state.stall_until = None       # programmatic-only, never from env
     _state.nan_step = _env_int(ENV_NAN_STEP)
 
 
 def configure(io_fail_writes: int = None, sigterm_step: int = None,
               sigterm_rank: int = None, stall_step: int = None,
               stall_s: float = None, nan_step: int = None,
-              io_fail_reads: int = None) -> None:
+              io_fail_reads: int = None, stall_until=None) -> None:
     """Programmatic arming (in-process tests); only the passed points move."""
+    if stall_until is not None:
+        _state.stall_until = stall_until
     if io_fail_writes is not None:
         _state.io_fail_writes = int(io_fail_writes)
     if io_fail_reads is not None:
@@ -172,7 +178,8 @@ def maybe_stall(step: int) -> None:
     dump must name ``chaos_stall``."""
     if _state.stall_step is not None and step == _state.stall_step:
         _state.stall_step = None        # one shot
-        chaos_stall(_state.stall_s)
+        until, _state.stall_until = _state.stall_until, None
+        chaos_stall(_state.stall_s, until=until)
 
 
 def chaos_stall(seconds: float, until=None) -> None:

@@ -210,6 +210,11 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
             # step-boundary preemption poll: collective agreement, so one
             # preempted host drains EVERY host here, at the same step
             if handler.should_stop():
+                # the spooled metric window may be mid-fill: flush it
+                # BEFORE the emergency save so the telemetry record is
+                # complete up to the drained step (no dropped final
+                # window — docs/observability.md)
+                _flush_telemetry(engine)
                 tag = f"{EMERGENCY_PREFIX}{tag_prefix}{engine.global_steps}"
                 if preempt_save:
                     save_with_retry(engine, save_dir, tag=tag,
@@ -238,7 +243,20 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
             save_with_retry(engine, save_dir, tag=f"{tag_prefix}{steps}",
                             client_state=_client_state(data_loader,
                                                        client_state))
+        _flush_telemetry(engine)
         return engine
     finally:
         if own_handler:
             handler.uninstall()
+
+
+def _flush_telemetry(engine) -> None:
+    """Drain the final (possibly partial) metric window — best-effort;
+    a telemetry failure must never turn a clean drain into a crash."""
+    flush = getattr(engine, "flush_telemetry", None)
+    if flush is None:
+        return
+    try:
+        flush()
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("resilience: telemetry flush failed: %s", e)

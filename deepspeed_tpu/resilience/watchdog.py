@@ -61,9 +61,14 @@ class Watchdog:
 
     def __init__(self, timeout_s: float, abort: bool = False,
                  near_miss_frac: float = 0.8, history: int = 32,
-                 poll_s: float = None):
+                 poll_s: float = None, on_fire=None):
         self.timeout_s = float(timeout_s)
         self.abort = bool(abort)
+        #: optional callable invoked (on the monitor thread) after the
+        #: stack dump and BEFORE any abort — the telemetry layer hooks a
+        #: short jax.profiler hang capture here so a wedged run leaves a
+        #: trace artifact, not just stacks (observability/tracing.py)
+        self.on_fire = on_fire
         self.near_miss_frac = float(near_miss_frac)
         self.poll_s = (poll_s if poll_s is not None
                        else max(0.02, min(1.0, self.timeout_s / 10.0)))
@@ -145,6 +150,13 @@ class Watchdog:
         self.fired = True
         COUNTERS.watchdog_fires += 1
         logger.error("%s", dump)
+        if self.on_fire is not None:
+            # best-effort diagnostics (hang trace capture): a hook failure
+            # must never mask the dump or block the abort path
+            try:
+                self.on_fire()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("watchdog on_fire hook failed: %s", e)
         self.fire_event.set()
         if self.abort:
             # the restart path takes over: flush the dump to stderr and

@@ -26,10 +26,10 @@ except ImportError:  # pragma: no cover
 
 
 def _fence(sync_on) -> None:
-    if sync_on is not None:
-        for leaf in jax.tree_util.tree_leaves(sync_on):
-            if hasattr(leaf, "block_until_ready"):
-                leaf.block_until_ready()
+    # routed through the telemetry fence accounting so the "zero per-step
+    # fences" contract is a pinned counter (observability/fences.py)
+    from deepspeed_tpu.observability import fences as obs_fences
+    obs_fences.fence_on(sync_on)
 
 
 class SynchronizedWallClockTimer:

@@ -103,6 +103,10 @@ def main():
             print(f"step {step:4d}  loss {float(loss):.5f}  "
                   f"scale {optimizer.cur_scale:.0f}")
 
+    # drain the final (possibly partial) telemetry window before exit —
+    # a no-op unless the config enables the observability metric spool
+    # (ds_config_telemetry.json; docs/observability.md)
+    engine.flush_telemetry()
     engine.save_checkpoint(args.ckpt_dir, client_state={"step": args.steps})
     print("final loss:", float(loss))
 

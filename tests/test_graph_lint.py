@@ -322,6 +322,36 @@ def test_seeded_host_sync_fixed_clean():
     assert not rep.errors, rep.format()
 
 
+def test_spool_drain_callback_allowlisted():
+    """The telemetry MetricSpool's batched drain io_callback is the ONE
+    sanctioned ordered host transfer: linted as ``transfer.spool-drain``
+    (info), NOT as a host-sync error (docs/observability.md)."""
+    from deepspeed_tpu.observability.spool import MetricSpool
+
+    sp = MetricSpool(4, on_window=lambda rows, pos: None)
+    closed = jax.make_jaxpr(sp.drain_program())(sp.state)
+    rep = analysis.analyze_jaxpr(closed, subject="spool_drain")
+    assert not rep.errors, rep.format()
+    assert any(f.code == "transfer.spool-drain" for f in rep.infos), \
+        rep.format()
+
+
+def test_unspooled_io_callback_still_errors():
+    """The allowlist keys on the drain marker, not the primitive: any
+    OTHER per-step io_callback in a step program stays an error."""
+    from jax.experimental import io_callback
+
+    def step(x):
+        io_callback(lambda v: None, None, x.sum(), ordered=True)
+        return x * 2
+
+    rep = analysis.analyze_jaxpr(jax.make_jaxpr(step)(jnp.ones(8)),
+                                 subject="bad_step")
+    errs = [f for f in rep.errors if f.code == "transfer.host-callback"]
+    assert errs, rep.format()
+    assert not any(f.code == "transfer.spool-drain" for f in rep.infos)
+
+
 # ======================================================================
 # engine wiring: the graph_lint config key
 # ======================================================================

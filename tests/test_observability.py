@@ -1,0 +1,499 @@
+"""Telemetry subsystem tests (docs/observability.md).
+
+The contracts pinned here ARE the design:
+
+* trajectory neutrality — losses and masters bitwise identical with the
+  metric spool on vs off (fused AND split API);
+* zero per-step fences — the deliberate-fence counter
+  (observability/fences.py) does not move between report windows;
+* no dropped windows — a flush (run end / preemption drain) delivers the
+  final partial window exactly once;
+* deferred skip accounting — fp16/nan-sentinel skip bookkeeping settles
+  at the drain with the same totals the per-boundary read produced, and
+  the documented scheduler exception retains the read;
+* one exporter — TensorBoard scalars ride the registry at window cadence
+  (spool on) or boundary cadence (spool off), JSONL events validate
+  against their own schema;
+* watchdog-triggered hang capture produces a loadable trace artifact.
+"""
+
+import glob
+import gzip
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.observability import Telemetry, fences, schema
+from deepspeed_tpu.observability import __main__ as obs_cli
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.resilience import COUNTERS, chaos
+from simple_model import LinearSumModel, SimpleModel
+
+HIDDEN = 8
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    COUNTERS.reset()
+    chaos.reset()
+    yield
+    COUNTERS.reset()
+    chaos.reset()
+
+
+def _cfg(obs=None, fp16=False, sched=False, gas=1, extra=None):
+    cfg = {
+        "train_batch_size": 16 * gas,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10 ** 9,
+    }
+    cfg["fp16" if fp16 else "bf16"] = (
+        {"enabled": True, "loss_scale": 0} if fp16 else {"enabled": True})
+    if sched:
+        cfg["scheduler"] = {"type": "WarmupLR",
+                            "params": {"warmup_num_steps": 10}}
+    if obs is not None:
+        cfg["observability"] = obs
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def _engine(cfg, model=None):
+    model = model or SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    return engine
+
+
+def _batch(i, n=16):
+    rng = np.random.default_rng(i)
+    x = rng.normal(size=(n, HIDDEN)).astype(np.float32)
+    y = rng.integers(0, HIDDEN, size=(n,)).astype(np.int32)
+    return x, y
+
+
+def _master_bytes(engine):
+    return b"".join(np.asarray(jax.device_get(l)).tobytes()
+                    for l in jax.tree_util.tree_leaves(engine.master))
+
+
+# ------------------------------------------------------ trajectory neutrality
+
+def test_spool_bitwise_on_off_fused(tmpdir):
+    """Metrics on/off must be invisible to the math: same losses (bitwise)
+    and same master weights after K fused steps."""
+    jsonl = str(tmpdir.join("t.jsonl"))
+    e_off = _engine(_cfg(sched=True, gas=2))
+    e_on = _engine(_cfg(obs={"report_window": 3, "jsonl_path": jsonl},
+                        sched=True, gas=2))
+    l_off, l_on = [], []
+    for i in range(7):
+        l_off.append(float(e_off.train_batch(_batch(i, 32))))
+        l_on.append(float(e_on.train_batch(_batch(i, 32))))
+    e_on.flush_telemetry()
+    assert l_off == l_on
+    assert _master_bytes(e_off) == _master_bytes(e_on)
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_spool_bitwise_on_off_zero(stage):
+    """The spool append wraps the shard_map'd step at the jit level, so
+    it must be neutral for partitioned layouts too (flat ZeRO-1 master /
+    per-leaf ZeRO-3 shards)."""
+    from deepspeed_tpu.models import GPT2
+
+    def build(obs):
+        model = GPT2.from_size("tiny", vocab_size=128, max_seq_len=16,
+                               num_layers=2, hidden_size=32, num_heads=4)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=_cfg(obs=obs, extra={
+                "zero_optimization": {"stage": stage}}),
+            model=model,
+            model_parameters=model.init_params(jax.random.PRNGKey(0)))
+        return engine
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 128, size=(16, 16)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    e_off, e_on = build(None), build({"report_window": 2})
+    for e in (e_off, e_on):
+        for _ in range(3):
+            e.train_batch((toks, labels))
+    e_on.flush_telemetry()
+
+    def snap(e):
+        leaves = ([e.master_flat] if e.zero_flat
+                  else jax.tree_util.tree_leaves(e.master))
+        return b"".join(np.asarray(jax.device_get(l)).tobytes()
+                        for l in leaves)
+
+    assert snap(e_off) == snap(e_on)
+
+
+def test_spool_bitwise_on_off_split():
+    e_off = _engine(_cfg(gas=2))
+    e_on = _engine(_cfg(obs={"report_window": 2}, gas=2))
+    for e in (e_off, e_on):
+        for i in range(3):
+            for m in range(2):
+                loss = e.forward(*_batch(10 * i + m))
+                e.backward(loss)
+                e.step()
+    e_on.flush_telemetry()
+    assert _master_bytes(e_off) == _master_bytes(e_on)
+    assert e_on.global_steps == 3
+
+
+# ---------------------------------------------------------- fence accounting
+
+def test_zero_fences_between_report_windows():
+    """THE regression contract: a spooled run takes no deliberate host
+    fence off report windows — and none ON them either (the drain is an
+    async callback); the only telemetry fence is the final flush."""
+    e = _engine(_cfg(obs={"report_window": 3}, sched=True))
+    e.train_batch(_batch(0))        # compile outside the pinned region
+    before = fences.FENCE_COUNT
+    for i in range(1, 7):           # crosses two window edges
+        e.train_batch(_batch(i))
+    assert fences.FENCE_COUNT == before, \
+        "spooled per-step path took a host fence"
+    e.flush_telemetry()
+    assert fences.FENCE_COUNT == before + 1     # the one deliberate flush
+
+
+def test_fence_counter_counts_legacy_sync():
+    """Counter sanity: the legacy fp16 path DOES fence per boundary (the
+    overflow read) — the spool's zero is meaningful, not a dead counter."""
+    model = LinearSumModel(dim=HIDDEN)
+    e = _engine(_cfg(fp16=True), model=model)
+    x = np.ones((16, HIDDEN), np.float16)
+    e.train_batch((x,))
+    before = fences.FENCE_COUNT
+    for _ in range(3):
+        e.train_batch((x,))
+    assert fences.FENCE_COUNT >= before + 3
+
+
+# ------------------------------------------------------------ window delivery
+
+def test_window_events_schema_and_partial_flush(tmpdir):
+    jsonl = str(tmpdir.join("events.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 3, "jsonl_path": jsonl}))
+    for i in range(8):
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    e.flush_telemetry()             # idempotent: no duplicate windows
+    assert schema.validate_jsonl(jsonl) == []
+    events = [json.loads(l) for l in open(jsonl)]
+    assert [ev["window_steps"] for ev in events] == [3, 3, 2]
+    assert [ev["step"] for ev in events] == [3, 6, 8]
+    # every boundary is covered exactly once — no dropped final window
+    assert sum(ev["window_steps"] for ev in events) == e.global_steps
+    # goodput: first window is unmeasured (includes compile), later ones
+    # carry step time and samples/s
+    assert events[0]["step_ms"] is None
+    assert events[1]["step_ms"] > 0
+    assert events[1]["samples_per_sec"] > 0
+    # the registry snapshot rides every event
+    assert "resilience/nan_skips" in events[0]["counters"]
+    assert "samples/lr" in events[0]["counters"]
+
+
+def test_planner_drift_columns(tmpdir):
+    jsonl = str(tmpdir.join("events.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 2, "jsonl_path": jsonl,
+                          "flops_per_sample": 1e6,
+                          "peak_tflops_per_chip": 100.0}))
+    # whoever measures the boundary (the BENCH_OBS leg does) feeds it
+    # here; every subsequent window event then carries the drift ratio
+    e.telemetry.measured_boundary_ms = 12.5
+    for i in range(4):
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    events = [json.loads(l) for l in open(jsonl)]
+    assert events[0]["measured_boundary_ms"] == 12.5
+    assert events[0]["boundary_drift"] == pytest.approx(
+        12.5 / events[0]["predicted_boundary_ms"], rel=1e-3)
+    # planner handoff (PR 6): predictions present in every window event
+    assert events[0]["predicted_peak_hbm_gb"] > 0
+    assert events[0]["predicted_boundary_ms"] is not None
+    assert events[0]["predicted_profile"]     # which profile priced them
+    # measured HBM is None on CPU (no allocator stats) — the column still
+    # exists, null: unmeasured and missing are different facts
+    assert "measured_peak_hbm_gb" in events[0]
+    assert "hbm_drift" in events[0]
+    assert events[1]["mfu"] > 0     # flops_per_sample + peak -> MFU column
+
+
+def test_jsonl_validator_cli(tmpdir, capsys):
+    good = str(tmpdir.join("good.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 2, "jsonl_path": good}))
+    for i in range(2):
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    assert obs_cli.main([good]) == 0
+    bad = str(tmpdir.join("bad.jsonl"))
+    with open(bad, "w") as f:
+        f.write(json.dumps({"schema": schema.SCHEMA_ID, "version": 1}) + "\n")
+    assert obs_cli.main([bad]) == 2
+    empty = str(tmpdir.join("empty.jsonl"))
+    open(empty, "w").close()
+    assert obs_cli.main([empty]) == 2       # "no telemetry" is a failure
+
+
+def test_schema_rejects_wrong_shapes():
+    base = {"schema": schema.SCHEMA_ID, "version": schema.SCHEMA_VERSION,
+            "ts": 1.0, "step": 3, "window_steps": 3, "skipped": 0,
+            "counters": {}}
+    for name, _ in schema.FIELDS.items():
+        base.setdefault(name, None)
+    assert schema.validate_event(base) is None
+    assert "version" in schema.validate_event({**base, "version": 99})
+    assert "window_steps" in schema.validate_event(
+        {**base, "window_steps": 0})
+    assert "skipped" in schema.validate_event({**base, "skipped": 5})
+    assert "step" in schema.validate_event({**base, "step": None})
+    # bool is not an int (a True in an int field is a bug, not a count)
+    assert schema.validate_event({**base, "skipped": True}) is not None
+
+
+def test_spool_deliver_wrap_and_overrun_guard(caplog):
+    """_deliver reads the ring wrap-safely and an overrun (more
+    undelivered appends than the ring holds — unreachable after flush's
+    effects barrier, but never allowed to slice garbage) drops the
+    overwritten rows LOUDLY, keeping the most recent window."""
+    import logging
+
+    from deepspeed_tpu.observability.spool import MetricSpool
+
+    got = []
+    sp = MetricSpool(4, on_window=lambda rows, pos: got.append(rows.copy()))
+    buf = np.arange(16, dtype=np.float32).reshape(4, 4)
+    # wrap: appends 3..5 live at rows 3, 0, 1
+    sp._drained = 3
+    sp._deliver(buf, 6)
+    assert got[-1][:, 0].tolist() == [buf[3, 0], buf[0, 0], buf[1, 0]]
+    # overrun: 6 undelivered appends in a 4-row ring -> keep newest 4
+    sp._drained = 0
+    with caplog.at_level(logging.ERROR,
+                         logger="deepspeed_tpu.observability.spool"):
+        sp._deliver(buf, 10)
+    assert any("spool overran" in r.message for r in caplog.records)
+    assert got[-1].shape[0] == 4
+    assert sp._drained == 10
+
+
+# ----------------------------------------------- deferred overflow accounting
+
+def _overflow_run(obs, sched=False, steps=6, poison=(2,)):
+    model = LinearSumModel(dim=HIDDEN)
+    e = _engine(_cfg(obs=obs, fp16=True, sched=sched), model=model)
+    rng = np.random.default_rng(0)
+    for i in range(steps):
+        x = rng.normal(size=(16, HIDDEN)).astype(np.float16)
+        if i in poison:
+            x = x.copy()
+            x[0, 0] = np.inf
+        e.train_batch((x,))
+    return e
+
+
+@pytest.mark.parametrize("window", [1, 3])
+def test_fp16_skip_accounting_defers_to_drain(window):
+    """window=1 is the adversarial case: the FIRST drain can run before
+    any boundary bookkeeping, so the deferral decision must be resolved
+    at telemetry build, not lazily."""
+    e_off = _overflow_run(None)
+    e_on = _overflow_run({"report_window": window})
+    e_on.flush_telemetry()
+    assert e_on.skipped_steps == e_off.skipped_steps
+    assert _master_bytes(e_on) == _master_bytes(e_off)
+
+
+def test_fp16_scheduler_exception_keeps_boundary_read(caplog):
+    """fp16 + LR scheduler: the skip contract gates scheduler.step(), so
+    the per-boundary overflow read is RETAINED (documented exception) —
+    trajectory identical to spool-off, fences observed."""
+    e_off = _overflow_run(None, sched=True)
+    before = fences.FENCE_COUNT
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="deepspeed_tpu.observability"):
+        e_on = _overflow_run({"report_window": 3}, sched=True)
+    assert fences.FENCE_COUNT > before          # the retained reads
+    assert any("overflow read RETAINED" in r.message
+               for r in caplog.records)
+    e_on.flush_telemetry()
+    assert e_on.skipped_steps == e_off.skipped_steps
+    assert _master_bytes(e_on) == _master_bytes(e_off)
+
+
+def test_nan_sentinel_skips_counted_at_drain():
+    model = LinearSumModel(dim=HIDDEN)
+    e = _engine(_cfg(obs={"report_window": 4},
+                     extra={"resilience": {"nan_sentinel": True}}),
+                model=model)
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        x = rng.normal(size=(16, HIDDEN)).astype(np.float32)
+        if i == 1:
+            x[0, 0] = np.nan
+        e.train_batch((x,))
+    e.flush_telemetry()
+    assert COUNTERS.nan_skips == 1
+    assert e.skipped_steps == 1
+
+
+# ------------------------------------------------------- preemption drain
+
+def test_preemption_drain_flushes_final_window(tmpdir, monkeypatch):
+    """run_resumable's drain must not drop the mid-fill window: every
+    completed boundary appears in the JSONL record before exit."""
+    from deepspeed_tpu import resilience
+
+    jsonl = str(tmpdir.join("events.jsonl"))
+    sentinel = str(tmpdir.join("preempt"))
+    monkeypatch.setenv("DSTPU_PREEMPT_FILE", sentinel)
+    cfg = _cfg(obs={"report_window": 4, "jsonl_path": jsonl})
+
+    def factory():
+        return _engine(cfg)
+
+    calls = {"n": 0}
+
+    def train_step(engine, _batch_unused):
+        calls["n"] += 1
+        if calls["n"] == 2:         # preempt mid-window (window = 4)
+            open(sentinel, "w").close()
+        engine.train_batch(_batch(calls["n"]))
+
+    with pytest.raises(SystemExit) as exc:
+        resilience.run_resumable(factory, train_step, steps=10,
+                                 save_dir=str(tmpdir.join("ck")))
+    assert exc.value.code == resilience.RESUME_EXIT_CODE
+    events = [json.loads(l) for l in open(jsonl)]
+    assert sum(ev["window_steps"] for ev in events) == 2
+    assert schema.validate_jsonl(jsonl) == []
+
+
+# ----------------------------------------------------------- exporter dedupe
+
+class _FakeWriter:
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, x):
+        self.scalars.append((tag, value, x))
+
+
+def test_legacy_boundary_scalars_ride_the_registry():
+    """Spool OFF: lr + resilience counters still reach TensorBoard per
+    boundary, with the historical tag spellings, through the ONE
+    registry path."""
+    e = _engine(_cfg())
+    w = _FakeWriter()
+    e.summary_writer = w       # the sink resolves the writer live
+    for i in range(2):
+        e.train_batch(_batch(i))
+    tags = {t for t, _, _ in w.scalars}
+    assert "Train/Samples/lr" in tags
+    assert "Train/Resilience/nan_skips" in tags
+    assert "Train/Resilience/compile_cache_hits" in tags
+    n_lr = sum(1 for t, _, _ in w.scalars if t == "Train/Samples/lr")
+    assert n_lr == 2                            # once per boundary
+
+
+def test_spooled_scalars_emit_per_window():
+    e = _engine(_cfg(obs={"report_window": 3}))
+    w = _FakeWriter()
+    e.summary_writer = w       # the sink resolves the writer live
+    for i in range(6):
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    losses = [s for s in w.scalars if s[0] == "Train/Telemetry/loss"]
+    assert len(losses) == 2                     # two windows, not six steps
+    assert any(t == "Train/Resilience/nan_skips" for t, _, _ in w.scalars)
+
+
+def test_resilience_counters_public_shape_unchanged():
+    e = _engine(_cfg(obs={"report_window": 2}))
+    keys = set(e.resilience_counters())
+    assert {"restarts", "preemptions", "nan_skips", "io_retries",
+            "watchdog_near_misses", "watchdog_fires", "restore_seconds",
+            "compile_cache_hits", "compile_cache_misses"} <= keys
+
+
+# ------------------------------------------------------------- config guards
+
+def test_observability_config_validation():
+    with pytest.raises(DeepSpeedConfigError, match="unknown observability"):
+        _engine(_cfg(obs={"report_windw": 3}))
+    with pytest.raises(DeepSpeedConfigError, match="trace destination"):
+        _engine(_cfg(obs={"trace_num_steps": 2}))
+    # a JSONL path without a window would create an event log that stays
+    # empty forever — loud, not silent
+    with pytest.raises(DeepSpeedConfigError, match="report_window"):
+        _engine(_cfg(obs={"jsonl_path": "/tmp/x.jsonl"}))
+    with pytest.raises(DeepSpeedConfigError, match="profiler capture"):
+        _engine(_cfg(obs={"trace_dir": "/tmp/x", "trace_num_steps": 2},
+                     extra={"profile": {"enabled": True, "start_step": 1,
+                                        "end_step": 2}}))
+
+
+def test_launcher_trace_dir_flag():
+    from deepspeed_tpu.launcher import launch, run
+    args = run.parse_args(["--trace_dir", "/tmp/tr", "script.py"])
+    assert args.trace_dir == "/tmp/tr"
+    largs = launch.parse_args(["--world_info", run.encode_world_info(
+        {"localhost": [0]}), "--trace_dir", "/tmp/tr", "x.py"])
+    assert largs.trace_dir == "/tmp/tr"
+
+
+# ---------------------------------------------------- tracing / hang capture
+
+@pytest.mark.chaos
+def test_watchdog_hang_capture_produces_loadable_trace(tmpdir):
+    """The chaos stall trips the hang deadline; the watchdog's on_fire
+    hook records a trace under <trace_dir>/hang_* and the artifact is
+    loadable (gzip JSON with content) — a wedged run leaves a profile,
+    not just stacks."""
+    trace_dir = str(tmpdir.join("traces"))
+    model = SimpleModel(hidden_dim=HIDDEN)
+    cfg = _cfg(obs={"report_window": 2, "trace_dir": trace_dir,
+                    "hang_capture_s": 0.3},
+               extra={"resilience": {"watchdog_timeout_s": 0.5}})
+    e = _engine(cfg, model=model)
+    chaos.configure(stall_step=1, stall_s=120.0,
+                    stall_until=e._watchdog.fire_event)
+    for i in range(3):
+        e.train_batch(_batch(i))
+    assert e._watchdog.fired
+    # the capture runs inside on_fire (before fire_event), so by the time
+    # the stall released, the artifact is on disk
+    files = [f for f in glob.glob(trace_dir + "/hang_*/**/*", recursive=True)
+             if os.path.isfile(f)]
+    assert files, "watchdog fire produced no trace artifact"
+    gz = [f for f in files if f.endswith(".trace.json.gz")]
+    assert gz
+    with gzip.open(gz[0]) as f:
+        trace = json.load(f)
+    assert trace.get("traceEvents") is not None
+
+
+@pytest.mark.chaos
+def test_scheduled_trace_window_captures(tmpdir):
+    trace_dir = str(tmpdir.join("traces"))
+    e = _engine(_cfg(obs={"report_window": 2, "trace_dir": trace_dir,
+                          "trace_start_step": 1, "trace_num_steps": 2}))
+    for i in range(5):
+        e.train_batch(_batch(i))
+    files = [f for f in glob.glob(trace_dir + "/steps_*/**/*",
+                                  recursive=True) if os.path.isfile(f)]
+    assert files, "scheduled capture window produced no artifact"
