@@ -182,7 +182,7 @@ def _measure_boundary(engine, batch, micro_n, repeats=None):
 
 def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
                warmup=2, obs_window=0, jsonl_path=None,
-               measure_boundary=None):
+               measure_boundary=None, obs_fleet=False):
     import jax
 
     import deepspeed_tpu
@@ -218,6 +218,11 @@ def run_config(size, seq, batch_per_chip, steps, remat, gas=1,
         obs = {"report_window": int(obs_window)}
         if jsonl_path:
             obs["jsonl_path"] = jsonl_path
+        if obs_fleet:
+            # fleet aggregation rides the same leg (fleet-of-1 here; the
+            # aggregation/detector path is identical to multi-host) —
+            # the fences_per_run == 1 contract must hold with it ON
+            obs["fleet"] = True
         cfg["observability"] = obs
     engine, _, _, _ = deepspeed_tpu.initialize(
         config=cfg,
@@ -1182,17 +1187,24 @@ def run_obs_bench():
     spool_runs = []
     for r in range(repeat):
         path = os.path.join(tmp, f"telemetry_{r}.jsonl")
-        spool_runs.append((run_config(size, seq, bpc, steps, remat, gas=gas,
-                                      obs_window=window, jsonl_path=path,
-                                      measure_boundary=True), path))
+        # fleet mode ON (BENCH_OBS_FLEET=0 opts out): the aggregation /
+        # detector / fleet-event path must be free on the hot path too —
+        # the fences_per_run contract below gates it
+        spool_runs.append((run_config(
+            size, seq, bpc, steps, remat, gas=gas,
+            obs_window=window, jsonl_path=path, measure_boundary=True,
+            obs_fleet=os.environ.get("BENCH_OBS_FLEET", "1") == "1"),
+            path))
     # one deliberate fence per run: the final flush (pinned exactly by
     # tests/test_observability.py; bench divides to stay robust to repeat)
     spool_fences = (fences.FENCE_COUNT - f0) // repeat
     spool, jsonl = max(spool_runs, key=lambda t: t[0]["per_chip"])
 
     problems = schema.validate_jsonl(jsonl)
-    with open(jsonl) as f:
-        windows = sum(1 for line in f if line.strip())
+    by_schema = schema.count_by_schema(jsonl)
+    windows = by_schema.get(schema.SCHEMA_ID, 0)
+    fleet_events = by_schema.get(schema.FLEET_SCHEMA_ID, 0)
+    startup_events = by_schema.get(schema.STARTUP_SCHEMA_ID, 0)
 
     ratio = spool["per_chip"] / base["per_chip"] if base["per_chip"] else None
     _emit({
@@ -1211,7 +1223,10 @@ def run_obs_bench():
         # reads are caller-side and uncounted; the counter regression is
         # pinned by tests/test_observability.py)
         "spooled_fences_per_run": spool_fences,
+        "fleet_mode": os.environ.get("BENCH_OBS_FLEET", "1") == "1",
         "jsonl_windows": windows,
+        "jsonl_fleet_events": fleet_events,
+        "jsonl_startup_events": startup_events,
         "jsonl_schema_valid": not problems,
         "measured_boundary_ms": spool.get("measured_boundary_ms"),
         "predicted_boundary_ms": spool.get("predicted_boundary_ms"),

@@ -450,7 +450,15 @@ class DeepSpeedConfig:
                      C.OBSERVABILITY_HANG_CAPTURE_S,
                      C.OBSERVABILITY_PLANNER_DRIFT,
                      C.OBSERVABILITY_FLOPS_PER_SAMPLE,
-                     C.OBSERVABILITY_PEAK_TFLOPS}
+                     C.OBSERVABILITY_PEAK_TFLOPS,
+                     C.OBSERVABILITY_FLEET,
+                     C.OBSERVABILITY_FLEET_WAIT_S,
+                     C.OBSERVABILITY_STRAGGLER_FACTOR,
+                     C.OBSERVABILITY_SPIKE_FACTOR,
+                     C.OBSERVABILITY_STARVATION_FRAC,
+                     C.OBSERVABILITY_HEALTH_PORT,
+                     C.OBSERVABILITY_FLIGHT_RECORDER,
+                     C.OBSERVABILITY_FLIGHT_RECORDER_DIR}
         if obs is not None and set(obs) - obs_known:
             # a typo'd window/trace knob would silently run the legacy
             # fenced paths — loud, like the resilience section
@@ -558,6 +566,73 @@ class DeepSpeedConfig:
                     f"{C.OBSERVABILITY}.{C.OBSERVABILITY_PEAK_TFLOPS} must "
                     f"be > 0")
         self.observability_peak_tflops_per_chip = ptf
+
+        # fleet observability: cross-host aggregation, straggler/anomaly
+        # detection, live health endpoints, flight recorder
+        # (docs/observability.md "Fleet view")
+        self.observability_fleet = bool(get_scalar_param(
+            obs, C.OBSERVABILITY_FLEET, C.OBSERVABILITY_FLEET_DEFAULT))
+        if (self.observability_fleet
+                and self.observability_report_window < 1):
+            # fleet reports are derived from window drains — without a
+            # window there is nothing to aggregate, ever
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_FLEET} requires "
+                f"{C.OBSERVABILITY_REPORT_WINDOW} >= 1 (fleet events "
+                f"aggregate per-host metric windows)")
+        self.observability_fleet_wait_s = _obs_num(
+            C.OBSERVABILITY_FLEET_WAIT_S,
+            C.OBSERVABILITY_FLEET_WAIT_S_DEFAULT, float)
+        if self.observability_fleet_wait_s <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_FLEET_WAIT_S} must "
+                f"be > 0 (the per-window aggregation deadline)")
+        self.observability_straggler_factor = _obs_num(
+            C.OBSERVABILITY_STRAGGLER_FACTOR,
+            C.OBSERVABILITY_STRAGGLER_FACTOR_DEFAULT, float)
+        if self.observability_straggler_factor <= 1.0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_STRAGGLER_FACTOR} "
+                f"must be > 1 (1.0 would flag the median host)")
+        self.observability_spike_factor = _obs_num(
+            C.OBSERVABILITY_SPIKE_FACTOR,
+            C.OBSERVABILITY_SPIKE_FACTOR_DEFAULT, float)
+        if self.observability_spike_factor <= 1.0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_SPIKE_FACTOR} must "
+                f"be > 1")
+        self.observability_starvation_frac = _obs_num(
+            C.OBSERVABILITY_STARVATION_FRAC,
+            C.OBSERVABILITY_STARVATION_FRAC_DEFAULT, float)
+        if not (0.0 < self.observability_starvation_frac <= 1.0):
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_STARVATION_FRAC} "
+                f"must be in (0, 1]")
+        self.observability_health_port = _obs_num(
+            C.OBSERVABILITY_HEALTH_PORT,
+            C.OBSERVABILITY_HEALTH_PORT_DEFAULT, int)
+        if not (0 <= self.observability_health_port <= 65535):
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_HEALTH_PORT} must be "
+                f"a port in [0, 65535] (0 disables; workers add their "
+                f"process index)")
+        self.observability_flight_recorder = _obs_num(
+            C.OBSERVABILITY_FLIGHT_RECORDER,
+            C.OBSERVABILITY_FLIGHT_RECORDER_DEFAULT, int)
+        if self.observability_flight_recorder < 0:
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_FLIGHT_RECORDER} "
+                f"must be >= 0 (entries; 0 disables the recorder)")
+        self.observability_flight_recorder_dir = get_scalar_param(
+            obs, C.OBSERVABILITY_FLIGHT_RECORDER_DIR,
+            C.OBSERVABILITY_FLIGHT_RECORDER_DIR_DEFAULT)
+        if self.observability_flight_recorder_dir is not None \
+                and not isinstance(self.observability_flight_recorder_dir,
+                                   str):
+            raise DeepSpeedConfigError(
+                f"{C.OBSERVABILITY}.{C.OBSERVABILITY_FLIGHT_RECORDER_DIR} "
+                f"must be a directory string, got "
+                f"{self.observability_flight_recorder_dir!r}")
 
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)

@@ -236,6 +236,41 @@ OBSERVABILITY_FLOPS_PER_SAMPLE = "flops_per_sample"
 OBSERVABILITY_FLOPS_PER_SAMPLE_DEFAULT = None
 OBSERVABILITY_PEAK_TFLOPS = "peak_tflops_per_chip"
 OBSERVABILITY_PEAK_TFLOPS_DEFAULT = None
+# fleet observability (docs/observability.md "Fleet view"): ship each
+# host's window report out-of-band to rank 0 (coordination-service KV
+# store — NEVER a device collective) and emit one dstpu.telemetry.fleet
+# event per window with per-host spreads + straggler/anomaly flags
+OBSERVABILITY_FLEET = "fleet"
+OBSERVABILITY_FLEET_DEFAULT = False
+# per-window aggregation deadline: hosts missing after this long are
+# listed in missing_hosts (itself a hang precursor) instead of blocking
+OBSERVABILITY_FLEET_WAIT_S = "fleet_wait_s"
+OBSERVABILITY_FLEET_WAIT_S_DEFAULT = 30.0
+# a host whose host-side time exceeds this multiple of the fleet median
+# is flagged as a straggler
+OBSERVABILITY_STRAGGLER_FACTOR = "straggler_factor"
+OBSERVABILITY_STRAGGLER_FACTOR_DEFAULT = 2.0
+# window loss/grad-norm beyond this multiple of the rolling median is a
+# spike anomaly
+OBSERVABILITY_SPIKE_FACTOR = "spike_factor"
+OBSERVABILITY_SPIKE_FACTOR_DEFAULT = 5.0
+# data-loader wait above this fraction of window step time flags
+# data starvation
+OBSERVABILITY_STARVATION_FRAC = "starvation_frac"
+OBSERVABILITY_STARVATION_FRAC_DEFAULT = 0.5
+# > 0 serves /healthz, /status and /metrics (Prometheus text) on
+# base_port + process_index; env fallback DSTPU_HEALTH_PORT
+# (dst --health_port); 0 disables
+OBSERVABILITY_HEALTH_PORT = "health_port"
+OBSERVABILITY_HEALTH_PORT_DEFAULT = 0
+# host-side flight-recorder ring size (entries; 0 disables) — dumped on
+# watchdog fire, preemption drain and crash exit
+OBSERVABILITY_FLIGHT_RECORDER = "flight_recorder"
+OBSERVABILITY_FLIGHT_RECORDER_DEFAULT = 256
+# dump destination (default: the JSONL log's directory, else trace_dir,
+# else cwd; env fallback DSTPU_FLIGHTREC_DIR)
+OBSERVABILITY_FLIGHT_RECORDER_DIR = "flight_recorder_dir"
+OBSERVABILITY_FLIGHT_RECORDER_DIR_DEFAULT = None
 
 #############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming

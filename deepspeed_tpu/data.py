@@ -142,14 +142,29 @@ class DeepSpeedDataLoader:
         return np.arange(n)
 
     def _place(self, batch):
-        """Shard the stacked numpy batch over the data axis."""
+        """Shard the stacked numpy batch over the data axis.
+
+        Multi-process, placement goes through ``make_array_from_callback``
+        — each process fills only its addressable shards from the batch
+        it already holds, with ZERO collectives.  A multi-host
+        ``jax.device_put`` of a host value runs per-leaf cross-host
+        consistency collectives instead (the PR 4 checkpoint-restore
+        lesson, found again here standing up the 2-process observability
+        smoke: the per-batch gloo ops interleave with the training
+        collectives on the shared TCP pair and corrupt the stream —
+        ``op.preamble.length <= op.nbytes`` aborts)."""
         if self._sharding is None:
             return batch
+        multi_host = jax.process_count() > 1
 
         def put(leaf):
             leaf = np.asarray(leaf)
             spec = P(DATA_AXIS) if leaf.ndim >= 1 else P()
-            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+            sharding = NamedSharding(self.mesh, spec)
+            if multi_host:
+                return jax.make_array_from_callback(
+                    leaf.shape, sharding, lambda idx, l=leaf: l[idx])
+            return jax.device_put(leaf, sharding)
 
         return jax.tree_util.tree_map(put, batch)
 

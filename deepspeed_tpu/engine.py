@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 import weakref
 from typing import Any, Callable, Optional
 
@@ -43,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deepspeed_tpu import analysis as graph_lint
 from deepspeed_tpu import constants as C
 from deepspeed_tpu.observability import fences as obs_fences
+from deepspeed_tpu.observability.flightrec import RECORDER as _flightrec
 from deepspeed_tpu.observability.tracing import annotate as _annotate
 from deepspeed_tpu import lr_schedules as schedules_mod
 from deepspeed_tpu import precision as prec
@@ -1195,13 +1197,16 @@ class DeepSpeedTpuEngine:
         unset) — the gate every spooled code path checks."""
         return self._telemetry.spool
 
-    def flush_telemetry(self):
+    def flush_telemetry(self, local_only=False, fleet_timeout=None):
         """Synchronously drain the final (possibly partial) metric window
         — THE one deliberate telemetry fence.  Called by the resilience
         driver on a preemption drain, at run completion, and before a
         checkpoint restore, so no window is ever dropped or mixed across
-        a restore; safe to call any time (idempotent)."""
-        self._telemetry.flush()
+        a restore; safe to call any time (idempotent).  ``local_only``
+        skips the bounded cross-host fleet wait (the preemption drain
+        uses it before the emergency save — see Telemetry.flush)."""
+        self._telemetry.flush(local_only=local_only,
+                              fleet_timeout=fleet_timeout)
 
     # ------------------------------------------------------------- data layer
 
@@ -2310,6 +2315,10 @@ class DeepSpeedTpuEngine:
         """Counters, overflow-aware LR step, progress + TB reporting after a
         boundary update (reference deepspeed_light.py:723-788)."""
         self.global_steps += 1
+        # post-mortem breadcrumb: which boundary this process last
+        # completed (flight recorder — who was at which step when the
+        # fleet diverged; docs/observability.md "Flight recorder")
+        _flightrec.record("boundary", step=self.global_steps)
         self._profile_window()
         self._telemetry.maybe_trace(self.global_steps)
         skip_contract = self.config.fp16_enabled or self._nan_sentinel
@@ -2407,6 +2416,11 @@ class DeepSpeedTpuEngine:
             with self._armed("optimizer boundary step"), \
                     _annotate("boundary"):
                 from deepspeed_tpu.resilience import chaos as _chaos
+                # same host-side pre-dispatch clock as train_batch (the
+                # fleet straggler signal; see docs/observability.md)
+                _t0 = time.monotonic()
+                _flightrec.record("arm", label="boundary",
+                                  step=self.global_steps)
                 _chaos.maybe_stall(self.global_steps)
                 spool = self._spool
                 if spool is not None:
@@ -2415,6 +2429,7 @@ class DeepSpeedTpuEngine:
                     # the spool can record it (device copy — no fence)
                     ls_scale_used = jnp.array(
                         self.loss_scale_state.cur_scale, copy=True)
+                _t1 = time.monotonic()
                 (self.params, new_master, self.opt_state,
                  self.loss_scale_state, overflow,
                  self._last_grad_norm) = self._step_fn(
@@ -2434,6 +2449,8 @@ class DeepSpeedTpuEngine:
                         else jnp.zeros((), jnp.float32),
                         self._last_grad_norm, ls_scale_used, overflow)
                 self._post_boundary_bookkeeping(overflow)
+                self._telemetry.note_boundary_host_seconds(
+                    _t1 - _t0, time.monotonic() - _t0)
                 if spool is not None:
                     self.tput_timer.stop(report_speed=False, sync_on=None)
                 else:
@@ -2611,7 +2628,16 @@ class DeepSpeedTpuEngine:
         # read / loss sync, not at the async dispatch
         with self._armed("train_batch"), _annotate("train_batch"):
             from deepspeed_tpu.resilience import chaos as _chaos
+            # host-side pre-dispatch clock: [region entry, program call)
+            # is time only THIS host pays (GC, data prep, an injected
+            # stall) — the fleet straggler signal; the collective wait
+            # rides the device queue and is excluded (two clock reads,
+            # same cost class as watchdog arming)
+            _t0 = time.monotonic()
+            _flightrec.record("arm", label="train_batch",
+                              step=self.global_steps)
             _chaos.maybe_stall(self.global_steps)
+            _t1 = time.monotonic()
             outs = self._train_batch_fn(*args)
             if spool is not None:
                 outs, new_spool = outs[:-1], outs[-1]
@@ -2627,6 +2653,8 @@ class DeepSpeedTpuEngine:
                 # async batched callback, the host never waits)
                 spool.note_append(new_spool)
             self._post_boundary_bookkeeping(overflow)
+            self._telemetry.note_boundary_host_seconds(
+                _t1 - _t0, time.monotonic() - _t0)
             if spool is not None:
                 # throughput/goodput ride the window drain timestamps;
                 # fencing (and printing dispatch-rate numbers) here would
@@ -2661,6 +2689,8 @@ class DeepSpeedTpuEngine:
         # the save stall is not training throughput: keep it out of the
         # next report window (timer.py window accounting)
         self.tput_timer.discard_window()
+        _flightrec.record("checkpoint.save", step=self.global_steps,
+                          tag=tag)
         with self._armed("save_checkpoint"), _annotate("checkpoint.save"):
             return ckpt_mod.save_checkpoint(self, save_dir, tag=tag,
                                             client_state=client_state,
@@ -2687,6 +2717,8 @@ class DeepSpeedTpuEngine:
         from deepspeed_tpu import checkpoint as ckpt_mod
         from deepspeed_tpu.resilience import COUNTERS
         t0 = _time.perf_counter()
+        _flightrec.record("checkpoint.load", step=self.global_steps,
+                          tag=tag)
         with self._armed("load_checkpoint"), _annotate("checkpoint.load"):
             path, client = ckpt_mod.load_checkpoint(
                 self, load_dir, tag=tag,

@@ -34,6 +34,7 @@ import sys
 import time
 
 from deepspeed_tpu.launcher.run import decode_world_info
+from deepspeed_tpu.observability.health import ENV_HEALTH_PORT
 from deepspeed_tpu.observability.tracing import ENV_TRACE_DIR
 from deepspeed_tpu.resilience import RESTARTABLE_EXIT_CODES
 from deepspeed_tpu.utils.compile_cache import ENV_DIR as COMPILE_CACHE_ENV_DIR
@@ -73,6 +74,12 @@ def parse_args(args=None):
                              "as DSTPU_TRACE_DIR — the engine resolves it "
                              "when the config carries no "
                              "observability.trace_dir")
+    parser.add_argument("--health_port", type=int, default=0,
+                        help="Base health-endpoint port exported to every "
+                             "spawned worker (including relaunches) as "
+                             "DSTPU_HEALTH_PORT; each worker serves "
+                             "/healthz /status /metrics on base + its "
+                             "global rank")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -121,6 +128,11 @@ def _spawn_procs(args, local_ranks, world_size, node_host):
             # same fallback pattern for trace captures (workers append a
             # per-process subdirectory — observability/tracing.py)
             env[ENV_TRACE_DIR] = args.trace_dir
+        if args.health_port:
+            # BASE port only: each worker offsets by its own global rank
+            # (observability/health.resolve_health_port), so co-hosted
+            # workers never fight over one socket
+            env[ENV_HEALTH_PORT] = str(args.health_port)
         cmd = ([sys.executable, "-u", args.training_script]
                + args.training_script_args
                + [f"--local_rank={local_rank}"])

@@ -83,6 +83,15 @@ def parse_args(args=None):
                              "capture windows and watchdog hang captures "
                              "land here, one subdirectory per process "
                              "(docs/observability.md)")
+    parser.add_argument("--health_port", type=int, default=0,
+                        help="Base port of the per-process live health "
+                             "endpoints (/healthz /status /metrics), "
+                             "exported to every worker (and every "
+                             "--max_restarts relaunch) as "
+                             "DSTPU_HEALTH_PORT; each worker serves on "
+                             "base + its global rank, rank 0 additionally "
+                             "carries the fleet view.  0 disables "
+                             "(docs/observability.md)")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat a single-node pool as multi-node (ssh)")
     parser.add_argument("user_script", type=str,
@@ -280,6 +289,8 @@ def main(args=None):
         launch_cmd += [f"--compile_cache_dir={args.compile_cache_dir}"]
     if args.trace_dir:
         launch_cmd += [f"--trace_dir={args.trace_dir}"]
+    if args.health_port:
+        launch_cmd += [f"--health_port={args.health_port}"]
 
     if not multi_node:
         cmd = launch_cmd + ["--node_rank=0", args.user_script] + args.user_args

@@ -30,13 +30,26 @@ Config::
       "hang_capture_s": 1.0,
       "planner_drift": true,       # predicted peak-HBM/boundary columns
       "flops_per_sample": null,    # enables the MFU column
-      "peak_tflops_per_chip": null
+      "peak_tflops_per_chip": null,
+      "fleet": false,              # cross-host aggregation -> rank-0
+                                   # dstpu.telemetry.fleet events
+      "fleet_wait_s": 30.0,        # per-window aggregation deadline
+      "straggler_factor": 2.0,     # host-time multiple of fleet median
+      "spike_factor": 5.0,         # loss/grad-norm spike multiple
+      "starvation_frac": 0.5,      # data-wait fraction of step time
+      "health_port": 0,            # > 0 serves /healthz /status /metrics
+                                   # (base + process_index; env
+                                   # DSTPU_HEALTH_PORT via dst --health_port)
+      "flight_recorder": 256,      # host-side event ring size (0 = off)
+      "flight_recorder_dir": null  # dump destination (watchdog fire /
+                                   # preemption drain / crash exit)
     }
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import weakref
@@ -44,10 +57,15 @@ from typing import Optional
 
 import numpy as np
 
+from deepspeed_tpu.observability import detectors  # noqa: F401
 from deepspeed_tpu.observability import fences  # noqa: F401  (re-export)
+from deepspeed_tpu.observability import fleet as fleet_mod
+from deepspeed_tpu.observability import flightrec  # noqa: F401
+from deepspeed_tpu.observability import health as health_mod
 from deepspeed_tpu.observability import schema  # noqa: F401
 from deepspeed_tpu.observability import spool as spool_mod
 from deepspeed_tpu.observability import tracing
+from deepspeed_tpu.observability.flightrec import RECORDER  # noqa: F401
 from deepspeed_tpu.observability.registry import (JsonlSink, MetricRegistry,
                                                   TensorboardSink)
 from deepspeed_tpu.observability.spool import MetricSpool
@@ -57,8 +75,8 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "Telemetry", "MetricSpool", "MetricRegistry", "TensorboardSink",
-    "JsonlSink", "Tracer", "annotate", "fences", "schema", "spool_mod",
-    "tracing",
+    "JsonlSink", "Tracer", "annotate", "detectors", "fences", "fleet_mod",
+    "flightrec", "health_mod", "schema", "spool_mod", "tracing", "RECORDER",
 ]
 
 
@@ -91,6 +109,38 @@ class Telemetry:
         self.measured_boundary_ms = None    # set by whoever measures it
         self.samples_per_step = (cfg.train_batch_size or 0)
         self._n_devices = jax.device_count()
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+
+        # fleet-observability bookkeeping: cold-start timing for the
+        # startup event, host-side pre-dispatch/data-wait accumulators
+        # for the per-host straggler signal, last-event snapshots for the
+        # live health endpoints
+        self._built_ts = time.time()
+        self._first_step_ts = None
+        self.first_dispatch_s = None
+        self._startup_emitted = False
+        self._host_s = 0.0
+        self._host_n = 0
+        self._data_wait_s = 0.0
+        self._data_wait_n = 0
+        self.last_window_event = None
+        self.last_fleet_event = None
+        self.startup_event = None
+        self._window_ordinal = 0
+
+        # flight recorder: the process ring is always on (recording is a
+        # locked deque append — ~free); the engine's config sizes it and
+        # points the dump directory (default: next to the JSONL log, else
+        # the trace dir, else cwd)
+        dump_dir = (cfg.observability_flight_recorder_dir
+                    or (os.path.dirname(os.path.abspath(
+                        cfg.observability_jsonl_path))
+                        if cfg.observability_jsonl_path else None)
+                    or cfg.observability_trace_dir)
+        RECORDER.configure(capacity=cfg.observability_flight_recorder,
+                           rank=self._rank, dump_dir=dump_dir)
+        flightrec.maybe_register_exit_dump()
 
         # sinks: TensorBoard rides the engine's writer, resolved LIVE at
         # emit time (rank-0 gated there; tests and users may swap the
@@ -104,21 +154,56 @@ class Telemetry:
             self.registry.add_sink(JsonlSink(self.jsonl_path))
 
         # sources: the deduped scalar producers (legacy tag spellings kept:
-        # Train/Samples/lr, Train/Resilience/*)
+        # Train/Samples/lr, Train/Resilience/*) + the detector counters
         from deepspeed_tpu.resilience import COUNTERS
         self.registry.register("resilience", COUNTERS.as_dict)
         self.registry.register("samples", self._samples_source)
+        self.registry.register("observability",
+                               detectors.COUNTERS.as_dict)
 
         # spool (report_window >= 1)
         self.spool: Optional[MetricSpool] = None
+        self._anomaly: Optional[detectors.WindowAnomalyDetector] = None
         if self.window >= 1:
             self.spool = MetricSpool(self.window, self._on_window)
+            self._anomaly = detectors.WindowAnomalyDetector(
+                self._rank,
+                spike_factor=cfg.observability_spike_factor,
+                starvation_frac=cfg.observability_starvation_frac)
             # resolve the deferral decision NOW (the scheduler exists —
             # the engine builds Telemetry last): at report_window=1 the
             # first drain can run before any boundary bookkeeping, and a
             # lazily-unresolved flag would silently skip that window's
             # deferred skip accounting
             self.defers_overflow(engine)
+
+        # fleet aggregation (docs/observability.md "Fleet view"): per-host
+        # window reports ship OUT-OF-BAND to rank 0 over the coordination
+        # service — host threads only, never a device collective, never
+        # the drain-callback thread
+        self.fleet: Optional[fleet_mod.FleetAggregator] = None
+        if cfg.observability_fleet and self.spool is not None:
+            self.fleet = fleet_mod.FleetAggregator(
+                world=self._world, rank=self._rank,
+                wait_s=cfg.observability_fleet_wait_s,
+                straggler_factor=cfg.observability_straggler_factor,
+                emit=self._emit_fleet_event)
+
+        # live health endpoints (opt-in: health_port config key or the
+        # launcher's --health_port env fallback, offset per process)
+        self.health: Optional[health_mod.HealthServer] = None
+        port = health_mod.resolve_health_port(
+            cfg.observability_health_port)
+        if port is not None:
+            try:
+                self.health = health_mod.HealthServer(
+                    port, self, rank=self._rank)
+            except OSError as e:
+                # a taken port must not take down training — loudly
+                # degraded, like every other telemetry failure
+                logger.warning(
+                    "telemetry: health endpoints DISABLED — could not "
+                    "bind port %d: %s", port, e)
 
         # tracer (trace_dir from config or DSTPU_TRACE_DIR)
         self.tracer: Optional[Tracer] = None
@@ -226,13 +311,18 @@ class Telemetry:
     def _on_window(self, rows: np.ndarray, pos: int) -> None:
         """Spool delivery (runtime callback thread on async drains, caller
         thread on flush): aggregate the window, settle the deferred
-        skip bookkeeping, emit through the registry."""
+        skip bookkeeping, emit through the registry, run the per-host
+        anomaly detectors and hand the fleet report off."""
         n = int(rows.shape[0])
         now = time.time()
         engine = self._engine_ref()
         with self._lock:
             base = self._base_step or 0
             last_ts, self._last_drain_ts = self._last_drain_ts, now
+            host_s, host_n = self._host_s, self._host_n
+            self._host_s, self._host_n = 0.0, 0
+            wait_s, wait_n = self._data_wait_s, self._data_wait_n
+            self._data_wait_s, self._data_wait_n = 0.0, 0
         step = base + pos
 
         skips = int(np.sum(rows[:, spool_mod.SKIP] > 0)) \
@@ -273,9 +363,37 @@ class Telemetry:
                         * float(self.flops_per_sample)
                         / (float(self.peak_tflops) * 1e12))
         event.update(self._capacity_columns())
+        # per-host fleet-report columns (schema v2): host-side pre-dispatch
+        # time is THE straggler signal — under lockstep SPMD one slow rank
+        # makes every rank's wall time slow, but only the straggler pays
+        # host-side time (docs/observability.md "Fleet view")
+        event["rank"] = self._rank
+        event["host_ms"] = (round(host_s / host_n * 1000.0, 4)
+                            if host_n else None)
+        event["data_wait_ms"] = (round(wait_s / max(wait_n, n) * 1000.0, 4)
+                                 if wait_n else None)
+        if self._anomaly is not None:
+            event["anomalies"] = self._anomaly.check_window(event)
         sample_count = (getattr(engine, "sample_count", None)
                         if engine is not None else None)
-        self.registry.emit(event, sample_count=sample_count)
+        self._maybe_emit_startup(step - n, sample_count)
+        counters = self.registry.counters_snapshot()
+        event.setdefault("counters", {}).update(counters)
+        self.registry.emit_event(event, sample_count=sample_count)
+        RECORDER.record("window", step=int(step), window_steps=n)
+        with self._lock:
+            self.last_window_event = event
+        if self.fleet is not None:
+            # enqueue only: the KV publish is a network RPC that must not
+            # ride the runtime callback thread.  Ordinal = deliveries so
+            # far on this rank: every rank drains at the same append
+            # counts (window edges + the SPMD-synchronous flush sites),
+            # so ordinals agree fleet-wide without any collective.
+            with self._lock:
+                self._window_ordinal += 1
+                ordinal = self._window_ordinal
+            self.fleet.publish(ordinal, fleet_mod.make_report(
+                event, rank=self._rank, counters=counters))
 
     def _capacity_columns(self) -> dict:
         """Measured-vs-predicted capacity (PR 6 planner handoff)."""
@@ -310,6 +428,140 @@ class Telemetry:
         with self._lock:
             self._base_step = int(global_steps) - self.spool._appended
 
+    def note_boundary_host_seconds(self, pre_s: float,
+                                   total_s: float = None) -> None:
+        """Engine hook, once per optimizer boundary: ``pre_s`` is the
+        host-side time from entering the armed boundary region to the
+        program dispatch call (two clock reads — the per-host straggler
+        signal: a rank stalling in host code pays it, a rank waiting
+        inside a collective does not); ``total_s`` is the whole armed
+        region's wall time, kept from the FIRST boundary as the
+        startup event's compile-dominated ``first_dispatch_s``."""
+        now = time.time()
+        with self._lock:
+            if self._first_step_ts is None:
+                self._first_step_ts = now
+                if total_s is not None:
+                    self.first_dispatch_s = float(total_s)
+            self._host_s += float(pre_s)
+            self._host_n += 1
+
+    def note_data_wait_seconds(self, seconds: float) -> None:
+        """Driver/loader hook: host time spent blocked waiting for the
+        next batch — the data-starvation detector's signal."""
+        with self._lock:
+            self._data_wait_s += float(seconds)
+            self._data_wait_n += 1
+
+    def _maybe_emit_startup(self, start_step: int, sample_count) -> None:
+        """One startup event per process, emitted just before the first
+        window event: the cold-start cost (compile + restore +
+        time-to-first-step) as recorded numbers — the first window's
+        ``step_ms`` stays honestly null (it contains compile), but the
+        cost itself must not be a missing value (docs/observability.md
+        "The startup event")."""
+        with self._lock:
+            if self._startup_emitted:
+                return
+            self._startup_emitted = True
+            first_ts = self._first_step_ts
+        from deepspeed_tpu.resilience import COUNTERS
+        import socket as _socket
+        event = {
+            "schema": schema.STARTUP_SCHEMA_ID,
+            "version": 2,
+            "ts": time.time(),
+            "rank": self._rank,
+            "host": _socket.gethostname(),
+            "step": max(int(start_step), 0),
+            "time_to_first_step_s": (round(first_ts - self._built_ts, 4)
+                                     if first_ts is not None else None),
+            "first_dispatch_s": (round(self.first_dispatch_s, 4)
+                                 if self.first_dispatch_s is not None
+                                 else None),
+            "restore_seconds": (round(COUNTERS.restore_seconds, 4)
+                                or None),
+            "compile_cache_hits": COUNTERS.compile_cache_hits,
+            "compile_cache_misses": COUNTERS.compile_cache_misses,
+        }
+        self.startup_event = event
+        self.registry.emit_event(event, sample_count=sample_count)
+
+    def _emit_fleet_event(self, event: dict) -> None:
+        """Aggregator-thread callback (rank 0): route the fleet event to
+        the sinks and the live endpoints."""
+        with self._lock:
+            self.last_fleet_event = event
+        RECORDER.record("fleet_window", window=event.get("window"),
+                        step=event.get("step"),
+                        stragglers=event.get("stragglers"),
+                        missing=event.get("missing_hosts"))
+        self.registry.emit_event(event)
+
+    # ------------------------------------------------------ health endpoints
+    def healthy(self) -> bool:
+        """Liveness verdict for ``/healthz``: alive and not wedged (a
+        fired watchdog means the process exists but trains nothing — the
+        state an orchestrator should replace)."""
+        from deepspeed_tpu.resilience import COUNTERS
+        return COUNTERS.watchdog_fires == 0
+
+    def health_snapshot(self) -> dict:
+        """``/status`` payload: engine step, last window/fleet events,
+        counters — all host-side state, no fences."""
+        engine = self._engine_ref()
+        with self._lock:
+            last_window = self.last_window_event
+            last_fleet = self.last_fleet_event
+        out = {
+            "healthy": self.healthy(),
+            "step": (int(engine.global_steps)
+                     if engine is not None else None),
+            "report_window": self.window,
+            "fleet": self.fleet is not None,
+            "last_window": last_window,
+            "startup": self.startup_event,
+            "counters": self.registry.counters_snapshot(),
+        }
+        if self._rank == 0 and self.fleet is not None:
+            out["last_fleet"] = last_fleet
+        return out
+
+    def health_metrics(self) -> dict:
+        """``/metrics`` payload (flat name -> number; the health server
+        renders Prometheus text): counters + the last window's goodput +
+        the rank-0 fleet roll-up."""
+        engine = self._engine_ref()
+        out = {k.replace("/", "_"): v
+               for k, v in self.registry.counters_snapshot().items()
+               if isinstance(v, (int, float))}
+        if engine is not None:
+            out["step"] = int(engine.global_steps)
+        out["healthy"] = 1 if self.healthy() else 0
+        with self._lock:
+            last_window = self.last_window_event
+            last_fleet = self.last_fleet_event
+        if last_window:
+            for name in ("loss", "loss_mean", "grad_norm", "step_ms",
+                         "samples_per_sec", "host_ms", "data_wait_ms",
+                         "mfu", "window_steps", "skipped"):
+                val = last_window.get(name)
+                if isinstance(val, (int, float)):
+                    out[f"window_{name}"] = val
+        if last_fleet:
+            for name in ("reported_hosts", "n_hosts", "straggler_index",
+                         "step_ms_max", "step_ms_median", "host_ms_max",
+                         "host_ms_median", "samples_per_sec_sum",
+                         "skipped_total"):
+                val = last_fleet.get(name)
+                if isinstance(val, (int, float)):
+                    out[f"fleet_{name}"] = val
+            out["fleet_stragglers"] = len(last_fleet.get("stragglers")
+                                          or [])
+            out["fleet_missing_hosts"] = len(
+                last_fleet.get("missing_hosts") or [])
+        return out
+
     def emit_boundary_scalars(self, sample_count) -> None:
         """Legacy-cadence TensorBoard export (spool OFF): the same source
         snapshot the window path emits, written per boundary through the
@@ -330,16 +582,32 @@ class Telemetry:
             return None
         return lambda: self.tracer.capture_hang()
 
-    def flush(self) -> None:
+    def flush(self, local_only: bool = False,
+              fleet_timeout: float = None) -> None:
         """Drain the final (possibly partial) window synchronously — run
-        end and preemption drain; the ONE deliberate telemetry fence."""
+        end and preemption drain; the ONE deliberate telemetry fence.
+        With fleet mode on, also waits (bounded) until this rank's
+        reports are published / rank 0's fleet events are emitted.
+
+        ``local_only`` skips the cross-host fleet wait: the preemption
+        drain flushes the spool BEFORE the emergency checkpoint (the
+        window record must cover the drained step) but must NOT spend
+        the grace period waiting on a possibly-dead peer while the
+        checkpoint is still unwritten — it re-flushes with a bounded
+        ``fleet_timeout`` after the save is durable."""
         if self.spool is not None:
             self.spool.flush()
+        if self.fleet is not None and not local_only:
+            self.fleet.flush(timeout=fleet_timeout)
 
     def close(self) -> None:
         self.flush()
         if self.tracer is not None:
             self.tracer.stop()
+        if self.fleet is not None:
+            self.fleet.close()
+        if self.health is not None:
+            self.health.close()
         self.registry.close()
 
 

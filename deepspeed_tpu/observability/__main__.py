@@ -1,10 +1,14 @@
 """JSONL event-log validator CLI.
 
 ``python -m deepspeed_tpu.observability <events.jsonl> [...]`` — validates
-every line of each telemetry event log against the window schema
-(observability/schema.py).  Exit codes: 0 = every file valid and
-non-empty, 2 = any problem (the CI observability smoke job's gate).
-Needs no jax — it is a pure-JSON check usable on artifact files anywhere.
+every line of each telemetry event log.  Streams may interleave the three
+event schemas (``dstpu.telemetry.window`` v1/v2, ``dstpu.telemetry.fleet``
+v2, ``dstpu.telemetry.startup`` v2 — observability/schema.py); v1
+window-only logs from before the fleet layer still validate.  Exit codes:
+0 = every file valid and non-empty, 2 = any problem — invalid lines,
+unknown schemas, unreadable or EMPTY files (the CI observability smoke
+job's gate, pinned by tests/test_fleet.py).  Needs no jax — it is a
+pure-JSON check usable on artifact files anywhere.
 """
 
 from __future__ import annotations
@@ -15,12 +19,23 @@ import sys
 from deepspeed_tpu.observability import schema
 
 
+def _summary(path: str) -> str:
+    counts = schema.count_by_schema(path)
+    short = {schema.SCHEMA_ID: "window", schema.FLEET_SCHEMA_ID: "fleet",
+             schema.STARTUP_SCHEMA_ID: "startup"}
+    parts = [f"{n} {short.get(sid, sid)}"
+             for sid, n in sorted(counts.items(),
+                                  key=lambda kv: -kv[1])]
+    return ", ".join(parts) or "0 events"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability",
-        description="Validate telemetry JSONL event logs "
-                    "(schema %s v%d)" % (schema.SCHEMA_ID,
-                                         schema.SCHEMA_VERSION))
+        description="Validate telemetry JSONL event logs (schemas: "
+                    "%s v1/v2, %s v2, %s v2)" % (
+                        schema.SCHEMA_ID, schema.FLEET_SCHEMA_ID,
+                        schema.STARTUP_SCHEMA_ID))
     parser.add_argument("paths", nargs="+", help="JSONL event log(s)")
     args = parser.parse_args(argv)
 
@@ -28,9 +43,7 @@ def main(argv=None) -> int:
     for path in args.paths:
         problems = schema.validate_jsonl(path)
         if not problems:
-            with open(path) as f:
-                n = sum(1 for line in f if line.strip())
-            print(f"{path}: OK ({n} event(s))")
+            print(f"{path}: OK ({_summary(path)})")
             continue
         rc = 2
         for line_no, msg in problems:

@@ -85,6 +85,14 @@ class MetricRegistry:
         with ``counters`` filled from the collected snapshot."""
         event = dict(event)
         event.setdefault("counters", {}).update(self.counters_snapshot())
+        self.emit_event(event, sample_count=sample_count)
+
+    def emit_event(self, event: dict,
+                   sample_count: Optional[int] = None) -> None:
+        """Fan a pre-built event (fleet/startup — or a window event whose
+        counters are already attached) out to every sink verbatim: no
+        source collection, no counter merge — the fleet event's counters
+        are a cross-host roll-up that a local snapshot must not clobber."""
         with self._lock:
             sinks = list(self._sinks)
         for sink in sinks:
@@ -114,9 +122,21 @@ class TensorboardSink:
     #: window-event fields exported as Train/Telemetry/* scalars
     _WINDOW_FIELDS = ("loss", "loss_mean", "grad_norm", "loss_scale",
                       "skipped", "step_ms", "samples_per_sec", "mfu",
+                      "host_ms", "data_wait_ms",
                       "measured_peak_hbm_gb", "hbm_drift",
                       "predicted_peak_hbm_gb", "predicted_boundary_ms",
                       "measured_boundary_ms", "boundary_drift")
+
+    #: fleet-event fields exported as Train/Fleet/* scalars (rank 0)
+    _FLEET_FIELDS = ("reported_hosts", "step_ms_min", "step_ms_median",
+                     "step_ms_max", "host_ms_min", "host_ms_median",
+                     "host_ms_max", "samples_per_sec_sum",
+                     "straggler_index", "loss_mean", "loss_spread",
+                     "skipped_total")
+
+    #: startup-event fields exported once as Train/Telemetry/* scalars
+    _STARTUP_FIELDS = ("time_to_first_step_s", "first_dispatch_s",
+                       "restore_seconds")
 
     def __init__(self, writer):
         #: a SummaryWriter, or a zero-arg callable resolving one LIVE —
@@ -135,6 +155,28 @@ class TensorboardSink:
         if writer is None:
             return
         x = sample_count if sample_count is not None else event["step"]
+        sid = event.get("schema")
+        if sid == schema.FLEET_SCHEMA_ID:
+            # rank-0 fleet roll-up: spread/straggler scalars + the count
+            # of flagged ranks (the alarmable number); per_host detail
+            # stays in the JSONL record
+            for name in self._FLEET_FIELDS:
+                val = event.get(name)
+                if val is not None:
+                    writer.add_scalar(f"Train/Fleet/{name}", float(val), x)
+            writer.add_scalar("Train/Fleet/stragglers",
+                              float(len(event.get("stragglers") or [])), x)
+            writer.add_scalar("Train/Fleet/missing_hosts",
+                              float(len(event.get("missing_hosts") or [])),
+                              x)
+            return
+        if sid == schema.STARTUP_SCHEMA_ID:
+            for name in self._STARTUP_FIELDS:
+                val = event.get(name)
+                if val is not None:
+                    writer.add_scalar(f"Train/Telemetry/{name}",
+                                      float(val), x)
+            return
         for name in self._WINDOW_FIELDS:
             val = event.get(name)
             if val is not None:
@@ -150,33 +192,43 @@ class TensorboardSink:
 
 
 class JsonlSink:
-    """One schema-stamped JSON line per window, flushed per emit (the file
+    """One schema-stamped JSON line per event, flushed per emit (the file
     must be complete up to the last drained window when the process is
     preempted — the flush-on-drain contract the resilience driver relies
-    on).  Lines that fail self-validation are still written but logged
-    loudly: a schema bug must be visible in CI, not silently dropped."""
+    on).  Events carrying their own ``schema`` stamp (fleet/startup) pass
+    through; unstamped events are window events and get the window schema
+    + null-filled field set.  Lines that fail self-validation are still
+    written but logged loudly: a schema bug must be visible in CI, not
+    silently dropped."""
 
     def __init__(self, path: str):
         self.path = path
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a")
+        # window emits arrive on the runtime callback thread, fleet emits
+        # on the aggregator thread — interleaved partial writes would
+        # corrupt the line framing the validator gates on
+        self._lock = threading.Lock()
 
     def emit(self, event: dict, sample_count: Optional[int] = None) -> None:
         event = dict(event)
-        event["schema"] = schema.SCHEMA_ID
-        event["version"] = schema.SCHEMA_VERSION
+        if event.get("schema") is None:
+            event["schema"] = schema.SCHEMA_ID
+            event["version"] = schema.SCHEMA_VERSION
+            # every schema field present (null when unmeasured): a missing
+            # column and an unmeasured column are different facts
+            for name in schema.FIELDS:
+                event.setdefault(name, None)
         event.setdefault("ts", time.time())
-        # every schema field present (null when unmeasured): a missing
-        # column and an unmeasured column are different facts
-        for name in schema.FIELDS:
-            event.setdefault(name, None)
-        msg = schema.validate_event(event)
+        msg = schema.validate_any(event)
         if msg is not None:  # pragma: no cover - schema bug guard
             logger.error("telemetry event fails its own schema (%s): %r",
                          msg, event)
-        self._f.write(json.dumps(event) + "\n")
-        self._f.flush()
+        line = json.dumps(event) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
 
     def close(self) -> None:
         try:

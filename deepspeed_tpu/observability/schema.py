@@ -1,15 +1,28 @@
-"""Machine-readable telemetry event schema (one JSONL line per window).
+"""Machine-readable telemetry event schemas (one JSONL line per event).
 
 The JSONL event log is the machine half of the exporter fan-out
-(TensorBoard is the human half): one line per drained report window,
-schema-versioned so downstream tooling (bench diffing, fleet dashboards,
-the CI smoke gate) can parse it without guessing.  Validation is
-hand-rolled — no jsonschema dependency — and doubles as the documentation
-of record for every field (docs/observability.md mirrors this table).
+(TensorBoard is the human half), schema-versioned so downstream tooling
+(bench diffing, fleet dashboards, the CI smoke gate) can parse it without
+guessing.  Validation is hand-rolled — no jsonschema dependency — and
+doubles as the documentation of record for every field
+(docs/observability.md mirrors these tables).
 
-Schema evolution contract: additive fields bump ``SCHEMA_VERSION`` minor
-semantics only (validators accept unknown EXTRA keys); removing or
-retyping a field is a breaking change and bumps the major version.
+Three event schemas share one stream (a rank-0 log interleaves them):
+
+* ``dstpu.telemetry.window``  — one line per drained metric window.
+  v1 (PR 7) logs still validate; v2 adds the per-host fleet-report
+  columns (``host_ms``, ``data_wait_ms``, ``anomalies``, ``rank``).
+* ``dstpu.telemetry.startup`` — one line per process start (v2): compile
+  / time-to-first-step seconds, restore latency, compile-cache counters —
+  the cold-start cost as a recorded number instead of the first window's
+  null ``step_ms``.
+* ``dstpu.telemetry.fleet``   — one line per cross-host aggregated window
+  (v2, rank 0 only): per-host min/median/max timings, straggler index and
+  flags, anomaly roll-up, counter sums, the full per-host report map.
+
+Schema evolution contract: additive fields bump the version with
+validators accepting all :data:`ACCEPTED_VERSIONS` and unknown EXTRA
+keys; removing or retyping a field is a breaking change.
 """
 
 from __future__ import annotations
@@ -18,15 +31,22 @@ import json
 import numbers
 from typing import Optional
 
-#: event-log schema identifier + version, stamped on every line
+#: window event-log schema identifier + current version
 SCHEMA_ID = "dstpu.telemetry.window"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+#: versions the validator accepts for window events (v1 = PR 7 logs)
+ACCEPTED_VERSIONS = (1, 2)
+
+#: fleet/startup schemas (introduced at v2 — no v1 ever existed)
+FLEET_SCHEMA_ID = "dstpu.telemetry.fleet"
+STARTUP_SCHEMA_ID = "dstpu.telemetry.startup"
 
 _NUM = numbers.Real
 
-#: field -> (type check, required).  Optional fields must still be PRESENT
-#: (null when unknown) — a missing column and an unmeasured column are
-#: different facts, and downstream diffing relies on a stable key set.
+#: field -> (type check, required[, min_version]).  Optional fields must
+#: still be PRESENT (null when unknown) in every event at or above their
+#: min version — a missing column and an unmeasured column are different
+#: facts, and downstream diffing relies on a stable key set.
 FIELDS = {
     "schema": (str, True),
     "version": (int, True),
@@ -55,23 +75,87 @@ FIELDS = {
     # which one applied
     "predicted_profile": (str, False),
     "counters": (dict, True),           # resilience/compile-cache counters
+    # ---- v2 (fleet observability): the per-host report columns --------
+    "rank": (int, False, 2),            # jax.process_index()
+    "host_ms": (_NUM, False, 2),        # mean host-side pre-dispatch ms per
+                                        # boundary (the straggler signal)
+    "data_wait_ms": (_NUM, False, 2),   # mean data-loader wait ms per
+                                        # boundary (starvation signal)
+    "anomalies": (list, False, 2),      # per-host detector flags
 }
 
+#: fleet event fields (schema ``dstpu.telemetry.fleet`` v2)
+FLEET_FIELDS = {
+    "schema": (str, True),
+    "version": (int, True),
+    "ts": (_NUM, True),
+    "window": (int, True),              # window ordinal (1-based)
+    "step": (int, True),                # max per-host step at window end
+    "n_hosts": (int, True),             # jax.process_count()
+    "reported_hosts": (int, True),      # reports in by the deadline
+    "missing_hosts": (list, True),      # ranks absent at the deadline —
+                                        # itself a hang precursor
+    "step_ms_min": (_NUM, False),       # wall step-time spread
+    "step_ms_median": (_NUM, False),
+    "step_ms_max": (_NUM, False),
+    "host_ms_min": (_NUM, False),       # host-side time spread (the
+    "host_ms_median": (_NUM, False),    # signal stragglers move)
+    "host_ms_max": (_NUM, False),
+    "samples_per_sec_sum": (_NUM, False),   # fleet goodput
+    "straggler_index": (_NUM, False),   # max/median host signal
+    "stragglers": (list, True),         # flagged ranks (may be empty)
+    "anomalies": (list, True),          # [{"rank": r, "kind": k}, ...]
+    "loss_mean": (_NUM, False),         # mean of per-host window means
+    "loss_spread": (_NUM, False),       # max - min (one-rank spikes show)
+    "skipped_total": (int, True),       # summed skip-on-overflow count
+    "counters": (dict, True),           # summed numeric counter roll-up
+    "per_host": (dict, True),           # rank(str) -> per-host report
+}
 
-def validate_event(event: dict) -> Optional[str]:
-    """Return None when ``event`` is a valid window event, else a message
-    naming the first problem.  Unknown extra keys are allowed (additive
-    schema evolution); known keys must carry the declared type or null
-    (optional fields only)."""
-    if not isinstance(event, dict):
-        return f"event is {type(event).__name__}, expected object"
-    if event.get("schema") != SCHEMA_ID:
-        return (f"schema is {event.get('schema')!r}, expected "
-                f"{SCHEMA_ID!r}")
-    if event.get("version") != SCHEMA_VERSION:
-        return (f"version is {event.get('version')!r}, expected "
-                f"{SCHEMA_VERSION}")
-    for name, (typ, required) in FIELDS.items():
+#: startup event fields (schema ``dstpu.telemetry.startup`` v2)
+STARTUP_FIELDS = {
+    "schema": (str, True),
+    "version": (int, True),
+    "ts": (_NUM, True),
+    "rank": (int, True),
+    "host": (str, False),
+    "step": (int, True),                # global step the run started from
+    #: engine build -> first completed optimizer boundary (wall seconds):
+    #: the cold-start cost the first window's null step_ms refuses to
+    #: launder into a throughput number
+    "time_to_first_step_s": (_NUM, False),
+    #: wall seconds of the first boundary dispatch (dominated by compile
+    #: on a cold cache)
+    "first_dispatch_s": (_NUM, False),
+    "restore_seconds": (_NUM, False),   # checkpoint restore latency
+    "compile_cache_hits": (int, False),
+    "compile_cache_misses": (int, False),
+}
+
+_SCHEMAS = None
+
+
+def _schemas():
+    global _SCHEMAS
+    if _SCHEMAS is None:
+        _SCHEMAS = {
+            SCHEMA_ID: (FIELDS, ACCEPTED_VERSIONS),
+            FLEET_SCHEMA_ID: (FLEET_FIELDS, (2,)),
+            STARTUP_SCHEMA_ID: (STARTUP_FIELDS, (2,)),
+        }
+    return _SCHEMAS
+
+
+def _validate_fields(event: dict, table: dict, versions) -> Optional[str]:
+    version = event.get("version")
+    if version not in versions:
+        return (f"version is {version!r}, expected one of "
+                f"{list(versions)}")
+    for name, spec in table.items():
+        typ, required = spec[0], spec[1]
+        min_version = spec[2] if len(spec) > 2 else min(versions)
+        if version < min_version:
+            continue        # the field postdates this event's schema
         if name not in event:
             return f"missing field {name!r}"
         val = event[name]
@@ -86,20 +170,92 @@ def validate_event(event: dict) -> Optional[str]:
         elif not isinstance(val, typ):
             return (f"field {name!r} must be "
                     f"{getattr(typ, '__name__', typ)}, got {val!r}")
+    return None
+
+
+def validate_event(event: dict) -> Optional[str]:
+    """Validate a WINDOW event (v1 or v2); returns None when valid, else a
+    message naming the first problem.  Unknown extra keys are allowed
+    (additive schema evolution)."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{SCHEMA_ID!r}")
+    msg = _validate_fields(event, FIELDS, ACCEPTED_VERSIONS)
+    if msg is not None:
+        return msg
     if event["window_steps"] <= 0:
         return f"window_steps must be > 0, got {event['window_steps']}"
     if not (0 <= event["skipped"] <= event["window_steps"]):
         return (f"skipped ({event['skipped']}) outside "
                 f"[0, window_steps={event['window_steps']}]")
-    for k, v in event["counters"].items():
+    return _validate_counters(event["counters"])
+
+
+def validate_fleet_event(event: dict) -> Optional[str]:
+    """Validate a FLEET event (rank-0 cross-host window roll-up)."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != FLEET_SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{FLEET_SCHEMA_ID!r}")
+    msg = _validate_fields(event, FLEET_FIELDS, (2,))
+    if msg is not None:
+        return msg
+    if event["n_hosts"] < 1:
+        return f"n_hosts must be >= 1, got {event['n_hosts']}"
+    if not (0 <= event["reported_hosts"] <= event["n_hosts"]):
+        return (f"reported_hosts ({event['reported_hosts']}) outside "
+                f"[0, n_hosts={event['n_hosts']}]")
+    for r in event["stragglers"]:
+        if not isinstance(r, int) or isinstance(r, bool):
+            return f"stragglers must list integer ranks, got {r!r}"
+    for a in event["anomalies"]:
+        if not (isinstance(a, dict) and "rank" in a and "kind" in a):
+            return f"anomalies entries need rank + kind, got {a!r}"
+    if not isinstance(event["per_host"], dict):
+        return "per_host must be an object"
+    return _validate_counters(event["counters"])
+
+
+def validate_startup_event(event: dict) -> Optional[str]:
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != STARTUP_SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{STARTUP_SCHEMA_ID!r}")
+    return _validate_fields(event, STARTUP_FIELDS, (2,))
+
+
+def _validate_counters(counters: dict) -> Optional[str]:
+    for k, v in counters.items():
         if not isinstance(k, str) or (v is not None
                                       and not isinstance(v, _NUM)):
             return f"counters[{k!r}] must map str -> number, got {v!r}"
     return None
 
 
+def validate_any(event: dict) -> Optional[str]:
+    """Dispatch on the event's ``schema`` field: window (v1/v2), fleet and
+    startup events all validate; anything else is invalid — a stream of
+    unknown schemas must fail the gate, not slide through."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    sid = event.get("schema")
+    if sid == SCHEMA_ID:
+        return validate_event(event)
+    if sid == FLEET_SCHEMA_ID:
+        return validate_fleet_event(event)
+    if sid == STARTUP_SCHEMA_ID:
+        return validate_startup_event(event)
+    return (f"unknown schema {sid!r}; expected one of "
+            f"[{SCHEMA_ID!r}, {FLEET_SCHEMA_ID!r}, {STARTUP_SCHEMA_ID!r}]")
+
+
 def validate_jsonl(path: str) -> list:
-    """Validate every line of a JSONL event log.  Returns a list of
+    """Validate every line of a JSONL event log (window/fleet/startup
+    events may interleave — a rank-0 fleet log does).  Returns a list of
     ``(line_number, message)`` problems (empty = valid); an unreadable or
     EMPTY file is a problem — the CI smoke gate treats "no telemetry" as
     a failure, not a pass."""
@@ -117,7 +273,7 @@ def validate_jsonl(path: str) -> list:
                 except ValueError as e:
                     problems.append((i, f"not valid JSON: {e}"))
                     continue
-                msg = validate_event(event)
+                msg = validate_any(event)
                 if msg is not None:
                     problems.append((i, msg))
     except OSError as e:
@@ -125,3 +281,23 @@ def validate_jsonl(path: str) -> list:
     if n == 0:
         problems.append((0, f"{path!r} contains no events"))
     return problems
+
+
+def count_by_schema(path: str) -> dict:
+    """``{schema_id_or_"invalid": count}`` over a JSONL file — the
+    validator CLI's per-file summary."""
+    out = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    sid = json.loads(line).get("schema") or "invalid"
+                except ValueError:
+                    sid = "invalid"
+                out[sid] = out.get(sid, 0) + 1
+    except OSError:
+        pass
+    return out

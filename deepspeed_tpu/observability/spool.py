@@ -73,6 +73,16 @@ def append(state, loss_out, grad_norm, loss_scale, overflow):
             "pos": state["pos"] + 1}
 
 
+def _host_local_view(x):
+    """This process's single-device view of a (replicated) global array —
+    no transfer, the local shard already lives on an addressable device.
+    Identity for host-local arrays (single-process runs, the split-API
+    spool state)."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        return x.addressable_shards[0].data
+    return x
+
+
 class MetricSpool:
     """Host-side spool driver: owns the device state, the append/drain
     programs and the window bookkeeping.
@@ -150,8 +160,17 @@ class MetricSpool:
 
     def drain_async(self) -> None:
         """Dispatch the drain program: the callback fires when the device
-        has produced the window's buffer — the host does NOT wait."""
-        self.drain_program()(self.state)
+        has produced the window's buffer — the host does NOT wait.
+
+        The drain runs over THIS PROCESS's view of the state
+        (:func:`_host_local_view`): a multi-host fused step program
+        returns the spool state globally replicated, and jitting the
+        drain over a global array runs its ``io_callback`` on ONE process
+        only — every other host would never deliver a window (found
+        standing up fleet aggregation, PR 9; pinned by the
+        ``fleet_straggler_watchdog`` distributed leg)."""
+        self.drain_program()(
+            {k: _host_local_view(v) for k, v in self.state.items()})
 
     def _deliver(self, buf: np.ndarray, pos: int) -> None:
         # delivery happens UNDER the lock: the counter update and the
@@ -191,5 +210,7 @@ class MetricSpool:
             jax.effects_barrier()
         except Exception as e:  # pragma: no cover - defensive
             logger.warning("telemetry flush: effects barrier failed: %s", e)
-        buf, pos = fences.read_arrays(self.state["buf"], self.state["pos"])
+        buf, pos = fences.read_arrays(
+            _host_local_view(self.state["buf"]),
+            _host_local_view(self.state["pos"]))
         self._deliver(buf, int(pos))

@@ -19,9 +19,11 @@ an uninterrupted run, data-iterator position included.
 from __future__ import annotations
 
 import logging
+from time import monotonic as _monotonic
 from typing import Callable, Optional
 
 from deepspeed_tpu import checkpoint as ckpt_mod
+from deepspeed_tpu.observability.flightrec import RECORDER as _flightrec
 from deepspeed_tpu.resilience import chaos
 from deepspeed_tpu.resilience.counters import COUNTERS
 from deepspeed_tpu.resilience.preempt import (PreemptionHandler,
@@ -186,11 +188,20 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
             nonlocal it
             if it is None:
                 return None
+            # time the blocking fetch: the telemetry data-starvation
+            # detector compares window data-wait against step time
+            # (docs/observability.md "Fleet view") — two clock reads
+            t0 = _monotonic()
             try:
-                return next(it)
+                batch = next(it)
             except StopIteration:
                 it = iter(data_loader)  # epoch rolled (loader re-shuffles)
-                return next(it)
+                batch = next(it)
+            note_wait = getattr(getattr(engine, "telemetry", None),
+                                "note_data_wait_seconds", None)
+            if note_wait is not None:
+                note_wait(_monotonic() - t0)
+            return batch
 
         while engine.global_steps < steps:
             step = engine.global_steps
@@ -210,11 +221,15 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
             # step-boundary preemption poll: collective agreement, so one
             # preempted host drains EVERY host here, at the same step
             if handler.should_stop():
-                # the spooled metric window may be mid-fill: flush it
-                # BEFORE the emergency save so the telemetry record is
-                # complete up to the drained step (no dropped final
-                # window — docs/observability.md)
-                _flush_telemetry(engine)
+                # the spooled metric window may be mid-fill: flush the
+                # LOCAL spool BEFORE the emergency save so the telemetry
+                # record is complete up to the drained step — but skip
+                # the cross-host fleet wait here: the preemption grace
+                # window belongs to the checkpoint, not to waiting on a
+                # possibly-dead peer (docs/observability.md)
+                _flightrec.record("preempt_agreed",
+                                  step=engine.global_steps)
+                _flush_telemetry(engine, local_only=True)
                 tag = f"{EMERGENCY_PREFIX}{tag_prefix}{engine.global_steps}"
                 if preempt_save:
                     save_with_retry(engine, save_dir, tag=tag,
@@ -230,6 +245,14 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
                         "resilience: preemption agreed at step %d "
                         "(preempt_save off); exiting %d",
                         engine.global_steps, RESUME_EXIT_CODE)
+                # checkpoint durable: NOW ship the final fleet report,
+                # on a short bound — best-effort telemetry must not eat
+                # what remains of the grace period
+                _flush_telemetry(engine, fleet_timeout=10.0)
+                # post-mortem artifact before the drain exit: which step
+                # this host reached (docs/observability.md "Flight
+                # recorder")
+                _flightrec.dump("preempt")
                 raise SystemExit(RESUME_EXIT_CODE)
 
             if save_interval and engine.global_steps % save_interval == 0 \
@@ -245,18 +268,27 @@ def run_resumable(engine_factory: Callable, train_step: Callable, *,
                                                        client_state))
         _flush_telemetry(engine)
         return engine
+    except SystemExit:
+        raise               # the drain path dumped above
+    except BaseException as e:
+        # crash exit: leave the ring on disk so the post-mortem knows the
+        # step this host died at — best-effort, never masks the crash
+        _flightrec.record("crash", step=engine.global_steps,
+                          error=repr(e)[:200])
+        _flightrec.dump("crash")
+        raise
     finally:
         if own_handler:
             handler.uninstall()
 
 
-def _flush_telemetry(engine) -> None:
+def _flush_telemetry(engine, **kwargs) -> None:
     """Drain the final (possibly partial) metric window — best-effort;
     a telemetry failure must never turn a clean drain into a crash."""
     flush = getattr(engine, "flush_telemetry", None)
     if flush is None:
         return
     try:
-        flush()
+        flush(**kwargs)
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("resilience: telemetry flush failed: %s", e)

@@ -141,15 +141,35 @@ class Watchdog:
     def _fire(self, label: str, elapsed: float) -> None:
         recent = "\n".join(f"  {lbl}: {dur * 1000.0:.1f} ms"
                            for lbl, dur in self.timings) or "  (none)"
+        # flight-recorder enrichment (observability/flightrec.py): the
+        # stack dump says where this thread is stuck NOW; the recorder
+        # tail says which step/window the process reached before it hung
+        # — together a post-mortem names the divergence point without
+        # reconstructing it.  jax-free import; best-effort.
+        flight = "  (unavailable)"
+        try:
+            from deepspeed_tpu.observability import flightrec
+            flight = flightrec.RECORDER.format_tail()
+        except Exception:  # pragma: no cover - defensive
+            pass
         dump = (f"WATCHDOG: {label!r} exceeded {self.timeout_s:.2f}s "
                 f"deadline ({elapsed:.2f}s elapsed)\n"
                 f"last {len(self.timings)} armed-operation timings:\n"
                 f"{recent}\n"
+                f"recent flight-recorder entries:\n{flight}\n"
                 f"all-thread stacks:\n{format_all_stacks()}")
         self.last_dump = dump
         self.fired = True
         COUNTERS.watchdog_fires += 1
         logger.error("%s", dump)
+        try:
+            # persist the ring next to the stack dump: the launcher may
+            # relaunch (or the abort below ends the process) — the file,
+            # not the log buffer, is what the post-mortem collects
+            from deepspeed_tpu.observability import flightrec
+            flightrec.RECORDER.dump("watchdog")
+        except Exception:  # pragma: no cover - defensive
+            pass
         if self.on_fire is not None:
             # best-effort diagnostics (hang trace capture): a hook failure
             # must never mask the dump or block the abort path

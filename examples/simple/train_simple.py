@@ -73,8 +73,14 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--ckpt_dir", type=str, default="/tmp/dst_simple")
+    parser.add_argument("--local_rank", type=int, default=-1)
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
+
+    # rendezvous when launched by `dst` (no-op single-process): the CI
+    # observability smoke runs this script 2-process with fleet
+    # aggregation + live health endpoints (docs/observability.md)
+    deepspeed_tpu.init_distributed()
 
     model = MLP()
     engine, optimizer, dataloader, _ = deepspeed_tpu.initialize(

@@ -29,6 +29,15 @@ if os.environ.get("_DSTPU_TEST_ENV") != "1":
     os.execve(sys.executable,
               [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
 
+import tempfile  # noqa: E402
+
+# flight-recorder dumps from bare-watchdog tests (no engine-configured
+# dump dir) must not litter the checkout: route the env-fallback dump
+# directory to a throwaway location (observability/flightrec.py resolve
+# order: configured dir > this env var > cwd)
+os.environ.setdefault("DSTPU_FLIGHTREC_DIR",
+                      tempfile.mkdtemp(prefix="dstpu_flightrec_test_"))
+
 import pytest  # noqa: E402  (post-re-exec: safe to import)
 
 import deepspeed_tpu  # noqa: E402,F401  (installs the jax compat shims —

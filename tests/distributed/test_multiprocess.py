@@ -104,6 +104,21 @@ def test_zero_mp_checkpoint_roles_multiprocess(tmpdir):
                       env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
 
 
+@pytest.mark.chaos
+def test_fleet_straggler_and_flight_recorder_multiprocess(tmpdir):
+    """ISSUE 9 fleet-observability proof: a ``chaos_stall`` injected on
+    rank 1 of a 2-process run is flagged as a straggler in rank 0's
+    ``dstpu.telemetry.fleet`` event BY HOST-SIDE TIME (wall step time is
+    near-identical — the healthy rank waits inside the collective); the
+    watchdog fires on both ranks and each leaves a loadable
+    flight-recorder dump naming the divergent step; the mixed JSONL
+    stream validates; and the whole fleet layer is bitwise
+    trajectory-neutral on the same run."""
+    spawn_distributed("fleet_straggler_watchdog", world_size=2,
+                      local_devices=2,
+                      env_extra={"DSTPU_TEST_DIR": str(tmpdir)})
+
+
 # --------------------------------------------------------------- launcher E2E
 
 E2E_SCRIPT = textwrap.dedent("""\

@@ -510,3 +510,156 @@ def zero3_ckpt_resume():
     assert path is not None
     post = [lm_step(resumed, i) for i in (3, 4)]
     assert post == ref[3:], (post, ref[3:])
+
+
+# ---------------------------------------------------------------- scenario 4
+
+def fleet_straggler_watchdog():
+    """ISSUE 9 fleet-observability chaos proof (2 processes):
+
+    * a ``chaos_stall`` injected on rank 1 mid-run makes rank 1's
+      host-side pre-dispatch time balloon — wall step time CANNOT name
+      the culprit (rank 0 waits just as long, inside the collective), the
+      host-side straggler signal MUST: the rank-0 fleet event flags rank
+      1 as the straggler;
+    * the stall outlives the watchdog deadline, so the watchdog fires on
+      BOTH ranks (rank 1 stalls in host code; rank 0 blocks in the gloo
+      collective behind it) and every host leaves a loadable
+      flight-recorder dump naming the divergent step;
+    * the JSONL record (window + fleet + startup events interleaved)
+      validator-gates clean, and bitwise trajectory parity vs
+      fleet-observability-off is asserted on the same run.
+    """
+    from deepspeed_tpu.observability import flightrec, schema
+    from deepspeed_tpu.resilience import chaos
+
+    rank = jax.process_index()
+    td = _test_dir()
+    jsonl = os.path.join(td, "fleet.jsonl")
+    STALL_STEP, STALL_S, WD_TIMEOUT = 3, 2.5, 1.0
+
+    # nan_sentinel + LR scheduler: the documented retained-read path
+    # (docs/observability.md "The scheduler exception") keeps the
+    # per-boundary overflow read INSIDE the armed region — which is what
+    # lets the HEALTHY rank's watchdog see a peer's hang: with the read
+    # deferred, a spooled healthy host never blocks inside an armed
+    # region (the collective wait rides the device queue), so only the
+    # stalled host would fire.  Both legs carry the same config so the
+    # step program (sentinel skip logic included) is identical.
+    base_cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 100}},
+        "bf16": {"enabled": True},
+        "resilience": {"nan_sentinel": True},
+    }
+
+    def make_engine(fleet: bool):
+        cfg = dict(base_cfg)
+        if fleet:
+            cfg["observability"] = {
+                "report_window": 2,
+                "jsonl_path": jsonl,
+                "fleet": True,
+                "fleet_wait_s": 60.0,
+                "straggler_factor": 2.0,
+                "flight_recorder_dir": td,
+            }
+            cfg["resilience"] = {"nan_sentinel": True,
+                                 "watchdog_timeout_s": WD_TIMEOUT}
+        engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=8),
+                                        config=cfg)
+        return engine
+
+    def batch(i):
+        rng = np.random.default_rng(500 + i)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        y = rng.integers(0, 8, size=(8,)).astype(np.int32)
+        return x, y
+
+    # baseline leg first (no observability, no chaos): the trajectory the
+    # fleet-observed run must reproduce bitwise
+    ref_engine = make_engine(fleet=False)
+    ref_losses = [float(ref_engine.train_batch(batch(i))) for i in range(6)]
+    ref_master = _master_bytes(ref_engine)
+    _barrier("fleet_baseline_done")
+
+    engine = make_engine(fleet=True)
+    engine._watchdog.poll_s = 0.05
+    if rank == 1:
+        # host-side stall on rank 1 ONLY, inside the armed boundary
+        # region, long enough to trip both ranks' watchdogs (rank 0
+        # blocks in the collective behind the straggler)
+        chaos.configure(stall_step=STALL_STEP, stall_s=STALL_S)
+    losses = [float(engine.train_batch(batch(i))) for i in range(6)]
+    engine.flush_telemetry()
+
+    # trajectory neutrality: the full fleet layer (spool + aggregation +
+    # detectors + recorder) changed NOTHING about the math — even with
+    # the chaos stall injected
+    assert losses == ref_losses, (losses, ref_losses)
+    assert _master_bytes(engine) == ref_master
+
+    # the stall outlived the deadline on BOTH watchdogs: rank 1 hung in
+    # host code; rank 0 hung at the (retained) boundary overflow read
+    # behind rank 1's collective — each leaves a loadable dump
+    assert engine._watchdog.fired, f"rank {rank}: watchdog did not fire"
+    dump_path = os.path.join(td, f"flightrec_rank{rank}_watchdog.json")
+    payload = flightrec.load_dump(dump_path)
+    assert payload["rank"] == rank
+    arms = [en for en in payload["entries"] if en["kind"] == "arm"]
+    # the divergent step: the last armed region when the fleet wedged
+    assert arms[-1]["step"] == STALL_STEP, arms[-1]
+    assert f"arm label=train_batch step={STALL_STEP}" \
+        in engine._watchdog.last_dump
+
+    _barrier("fleet_run_done")
+
+    if rank == 0:
+        # both hosts' dumps are on shared storage and loadable
+        for r in range(2):
+            p = flightrec.load_dump(
+                os.path.join(td, f"flightrec_rank{r}_watchdog.json"))
+            assert p["rank"] == r
+            assert any(en.get("step") == STALL_STEP
+                       for en in p["entries"]), p["entries"][-3:]
+
+        # the fleet record: schema-valid mixed stream, every window
+        # aggregated from BOTH hosts, and the stall window names rank 1
+        # as the straggler — by host-side time, with wall time near-equal
+        assert schema.validate_jsonl(jsonl) == []
+        import json as _json
+        lines = [_json.loads(l) for l in open(jsonl)]
+        fleet_evs = [ev for ev in lines
+                     if ev["schema"] == schema.FLEET_SCHEMA_ID]
+        assert [ev["window"] for ev in fleet_evs] == [1, 2, 3]
+        for ev in fleet_evs:
+            assert ev["n_hosts"] == 2
+            assert ev["reported_hosts"] == 2, ev
+            assert ev["missing_hosts"] == []
+        flagged = [ev for ev in fleet_evs if ev["stragglers"]]
+        assert len(flagged) == 1, [(ev["window"], ev["stragglers"])
+                                   for ev in fleet_evs]
+        ev = flagged[0]
+        assert ev["stragglers"] == [1], ev
+        assert ev["window"] == 2        # boundaries 3-4 hold the stall
+        # with 2 hosts the max/median(all) index tops out near 2.0 (the
+        # straggler drags the midpoint median toward itself — exactly why
+        # flagging uses the leave-one-out median instead)
+        assert ev["straggler_index"] > 1.5
+        # the per-host detail shows WHY: rank 1's host_ms carries the
+        # stall, rank 0's does not (the stall may smear across one
+        # window edge — the drain callback and the boundary's host-time
+        # note race benignly — so assert a third, not the full mean)
+        h0 = ev["per_host"]["0"]["host_ms"]
+        h1 = ev["per_host"]["1"]["host_ms"]
+        assert h1 > 1000.0 * STALL_S / 3 * 0.8, (h0, h1)
+        assert h0 < h1 / 10.0, (h0, h1)
+        # startup events recorded the cold start on rank 0's stream
+        startups = [ev for ev in lines
+                    if ev["schema"] == schema.STARTUP_SCHEMA_ID]
+        assert len(startups) == 1
+        assert startups[0]["time_to_first_step_s"] > 0
+    _barrier("fleet_asserts_done")

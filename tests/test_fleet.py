@@ -1,0 +1,485 @@
+"""Fleet observability tests (docs/observability.md "Fleet view").
+
+Contracts pinned here:
+
+* trajectory neutrality — fleet aggregation on vs observability off is
+  bitwise identical (losses + master weights), and adds zero per-step
+  fences (the fleet path consumes numbers the drain already put on the
+  host);
+* fleet events — one ``dstpu.telemetry.fleet`` line per window on rank 0,
+  schema-valid, with per-host spreads, counter roll-ups and straggler /
+  anomaly flags;
+* detectors — a stalled host is flagged by host-side time (leave-one-out
+  median), spikes by rolling baselines, starvation by data-wait fraction;
+  a steady run flags NOTHING (the no-false-positive regression);
+* startup events — cold start is a recorded number;
+* flight recorder — bounded ring, loadable dumps, watchdog enrichment;
+* health endpoints — /healthz, /status, /metrics answer from a live
+  engine; /metrics parses as Prometheus text;
+* validator CLI — mixed window/fleet/startup streams validate; invalid
+  and empty streams still exit 2 (the pinned gate).
+
+The 2-process straggler/flight-recorder legs live in
+``tests/distributed/test_multiprocess.py`` (``fleet_straggler_watchdog``).
+"""
+
+import json
+import os
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.observability import (detectors, fences, flightrec,
+                                         health_mod, schema)
+from deepspeed_tpu.observability import __main__ as obs_cli
+from deepspeed_tpu.resilience import COUNTERS, chaos
+from simple_model import SimpleModel
+
+HIDDEN = 8
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    COUNTERS.reset()
+    detectors.COUNTERS.reset()
+    chaos.reset()
+    yield
+    COUNTERS.reset()
+    detectors.COUNTERS.reset()
+    chaos.reset()
+
+
+def _cfg(obs=None, extra=None):
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10 ** 9,
+    }
+    if obs is not None:
+        cfg["observability"] = obs
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def _engine(cfg):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    return engine
+
+
+def _batch(i, n=16):
+    rng = np.random.default_rng(i)
+    x = rng.normal(size=(n, HIDDEN)).astype(np.float32)
+    y = rng.integers(0, HIDDEN, size=(n,)).astype(np.int32)
+    return x, y
+
+
+def _master_bytes(engine):
+    return b"".join(np.asarray(jax.device_get(l)).tobytes()
+                    for l in jax.tree_util.tree_leaves(engine.master))
+
+
+# ------------------------------------------------------- fleet event stream
+
+def test_fleet_events_emitted_and_schema_valid(tmpdir):
+    """Single-process fleet-of-1: every window produces a fleet event
+    (loopback transport — same aggregation code path as multi-host),
+    interleaved with window events in one schema-valid stream."""
+    jsonl = str(tmpdir.join("t.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 2, "jsonl_path": jsonl,
+                          "fleet": True, "fleet_wait_s": 10.0}))
+    for i in range(5):
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    assert schema.validate_jsonl(jsonl) == []
+    lines = [json.loads(l) for l in open(jsonl)]
+    fl = [ev for ev in lines if ev["schema"] == schema.FLEET_SCHEMA_ID]
+    win = [ev for ev in lines if ev["schema"] == schema.SCHEMA_ID]
+    # one fleet event per drained window (2 full + the flushed partial)
+    assert [ev["window"] for ev in fl] == [1, 2, 3]
+    assert len(win) == 3
+    for ev in fl:
+        assert ev["n_hosts"] == 1
+        assert ev["reported_hosts"] == 1
+        assert ev["missing_hosts"] == []
+        assert ev["stragglers"] == []
+        assert "0" in ev["per_host"]
+        assert ev["per_host"]["0"]["step"] == ev["step"]
+        # counter roll-up carries the summed resilience counters
+        assert "resilience/nan_skips" in ev["counters"]
+    # measured windows roll host time up into the spread columns
+    assert fl[1]["host_ms_median"] is not None
+    assert fl[1]["samples_per_sec_sum"] > 0
+    assert detectors.COUNTERS.fleet_windows == 3
+    assert detectors.COUNTERS.fleet_reports_missing == 0
+
+
+def test_fleet_bitwise_on_off_and_zero_fences():
+    """THE neutrality contract with the full fleet layer on: bitwise
+    identical losses + master weights vs observability off, and zero
+    per-step fences (one deliberate flush at the end)."""
+    e_off = _engine(_cfg())
+    e_on = _engine(_cfg(obs={"report_window": 2, "fleet": True}))
+    l_off, l_on = [], []
+    for i in range(5):
+        l_off.append(float(e_off.train_batch(_batch(i))))
+        l_on.append(float(e_on.train_batch(_batch(i))))
+    before = fences.FENCE_COUNT
+    for i in range(5, 9):
+        e_on.train_batch(_batch(i))
+    assert fences.FENCE_COUNT == before, \
+        "fleet aggregation took a per-step host fence"
+    e_on.flush_telemetry()
+    assert fences.FENCE_COUNT == before + 1     # the one flush
+    for i in range(5, 9):
+        e_off.train_batch(_batch(i))
+    assert l_off == l_on
+    assert _master_bytes(e_off) == _master_bytes(e_on)
+
+
+def test_steady_run_no_false_positives(tmpdir):
+    """The anomaly/straggler detectors flag NOTHING on a steady run —
+    alarm fatigue is how observability gets turned off."""
+    jsonl = str(tmpdir.join("t.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 2, "jsonl_path": jsonl,
+                          "fleet": True}))
+    for i in range(16):     # 8 windows: plenty of baseline history
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    lines = [json.loads(l) for l in open(jsonl)]
+    for ev in lines:
+        if ev["schema"] == schema.SCHEMA_ID:
+            assert ev["anomalies"] == [], ev
+        elif ev["schema"] == schema.FLEET_SCHEMA_ID:
+            assert ev["stragglers"] == [], ev
+            assert ev["anomalies"] == [], ev
+    assert detectors.COUNTERS.stragglers_flagged == 0
+    assert detectors.COUNTERS.loss_spikes == 0
+    assert detectors.COUNTERS.grad_norm_spikes == 0
+    assert detectors.COUNTERS.data_starvation_windows == 0
+
+
+def test_loss_spike_flagged_in_window_and_fleet(tmpdir):
+    """A poisoned batch mid-run spikes the window loss: the per-host
+    detector flags it, the flag rides the window event, the fleet event
+    and the counters."""
+    jsonl = str(tmpdir.join("t.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 1, "jsonl_path": jsonl,
+                          "fleet": True, "spike_factor": 4.0}))
+    for i in range(8):
+        x, y = _batch(i)
+        if i == 6:          # after >= 3 baseline windows
+            x = (x * 1000.0).astype(np.float32)
+        e.train_batch((x, y))
+    e.flush_telemetry()
+    lines = [json.loads(l) for l in open(jsonl)]
+    spiked = [ev for ev in lines if ev["schema"] == schema.SCHEMA_ID
+              and "loss_spike" in (ev["anomalies"] or [])]
+    assert [ev["step"] for ev in spiked] == [7]
+    fleet_flags = [ev for ev in lines
+                   if ev["schema"] == schema.FLEET_SCHEMA_ID
+                   and {"rank": 0, "kind": "loss_spike"} in ev["anomalies"]]
+    assert len(fleet_flags) == 1
+    assert detectors.COUNTERS.loss_spikes >= 1
+
+
+# ------------------------------------------------------------------ detectors
+
+def test_straggler_detector_leave_one_out():
+    det = detectors.StragglerDetector(2.0)
+    healthy = {r: {"host_ms": 2.0 + 0.1 * r, "step": 10}
+               for r in range(4)}
+    v = det.check_fleet(healthy)
+    assert v["stragglers"] == []
+    slow = dict(healthy)
+    slow[2] = {"host_ms": 900.0, "step": 10}
+    v = det.check_fleet(slow)
+    assert v["stragglers"] == [2]
+    assert v["straggler_index"] > 100
+    assert detectors.COUNTERS.stragglers_flagged == 1
+    # sub-floor deviations are jitter, not stragglers
+    jitter = {0: {"host_ms": 1.0}, 1: {"host_ms": 40.0}}
+    assert det.check_fleet(jitter)["stragglers"] == []
+
+
+def test_straggler_detector_data_wait_counts():
+    """Data wait is part of the host-side signal: a starving host is a
+    straggler even when its pre-dispatch compute time is fine."""
+    det = detectors.StragglerDetector(2.0)
+    v = det.check_fleet({
+        0: {"host_ms": 2.0, "data_wait_ms": 0.0},
+        1: {"host_ms": 2.0, "data_wait_ms": 800.0},
+    })
+    assert v["stragglers"] == [1]
+
+
+def test_spike_detector_rejects_learning_baseline():
+    """A spiking value must NOT join the baseline — otherwise a diverging
+    run teaches the detector that divergence is normal."""
+    sd = detectors.SpikeDetector(3.0)
+    for v in (1.0, 1.1, 0.9, 1.0):
+        assert not sd.check(v)
+    assert sd.check(100.0)
+    assert sd.check(100.0)      # still a spike on repeat
+    assert not sd.check(1.05)   # baseline intact
+    assert sd.check(float("nan"))   # non-finite is always a spike
+
+
+def test_window_anomaly_detector_starvation():
+    det = detectors.WindowAnomalyDetector(rank=0, spike_factor=5.0,
+                                          starvation_frac=0.5)
+    ok = {"loss_mean": 1.0, "grad_norm": 1.0, "step_ms": 100.0,
+          "data_wait_ms": 10.0, "step": 1}
+    assert det.check_window(ok) == []
+    starved = dict(ok, data_wait_ms=90.0, step=2)
+    assert "data_starvation" in det.check_window(starved)
+    assert detectors.COUNTERS.data_starvation_windows == 1
+
+
+# ------------------------------------------------------------ flight recorder
+
+def test_flight_recorder_ring_bounds_and_dump(tmpdir):
+    r = flightrec.FlightRecorder(capacity=8, rank=3)
+    for i in range(20):
+        r.record("boundary", step=i)
+    entries = r.tail()
+    assert len(entries) == 8
+    assert [e["step"] for e in entries] == list(range(12, 20))
+    assert "boundary step=19" in r.format_tail(4)
+    path = r.dump("test", path=str(tmpdir.join("d.json")))
+    payload = flightrec.load_dump(path)
+    assert payload["rank"] == 3
+    assert len(payload["entries"]) == 8
+    # per-reason idempotence: a second dump returns the first artifact
+    assert r.dump("test", path=str(tmpdir.join("other.json"))) == path
+    with pytest.raises(ValueError, match="not a flight-recorder dump"):
+        bad = tmpdir.join("bad.json")
+        bad.write('{"schema": "something.else"}')
+        flightrec.load_dump(str(bad))
+
+
+def test_flight_recorder_records_engine_breadcrumbs(tmpdir):
+    """A trained engine leaves the post-mortem trail: arm + boundary per
+    step, window drains, checkpoint saves."""
+    e = _engine(_cfg(obs={"report_window": 2,
+                          "flight_recorder_dir": str(tmpdir)}))
+    for i in range(3):
+        e.train_batch(_batch(i))
+    e.save_checkpoint(str(tmpdir.join("ck")), tag="t0")
+    e.flush_telemetry()
+    kinds = [en["kind"] for en in flightrec.RECORDER.tail()]
+    assert "arm" in kinds and "boundary" in kinds
+    assert "window" in kinds and "checkpoint.save" in kinds
+    steps = [en["step"] for en in flightrec.RECORDER.tail()
+             if en["kind"] == "boundary"]
+    assert steps[-1] == 3
+
+
+def test_flight_recorder_disabled_by_config():
+    e = _engine(_cfg(obs={"flight_recorder": 0}))
+    flightrec.RECORDER.record("x")
+    assert flightrec.RECORDER.tail() == []
+    assert flightrec.RECORDER.dump("test") is None
+    del e
+
+
+# ------------------------------------------------------------ health endpoints
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_health_endpoints_live_engine(tmpdir):
+    """/healthz, /status and /metrics answer from a live engine; /metrics
+    parses as Prometheus text and carries the window goodput."""
+    e = _engine(_cfg(obs={"report_window": 2, "fleet": True}))
+    srv = health_mod.HealthServer(0, e.telemetry, rank=0)
+    try:
+        for i in range(4):
+            e.train_batch(_batch(i))
+        e.flush_telemetry()
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(base + "/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+        code, body = _get(base + "/status")
+        status = json.loads(body)
+        assert status["step"] == 4
+        assert status["last_window"]["window_steps"] == 2
+        assert status["last_fleet"]["n_hosts"] == 1
+        assert "resilience/nan_skips" in status["counters"]
+        code, body = _get(base + "/metrics")
+        metrics = health_mod.parse_prometheus_text(body.decode())
+        assert metrics["dstpu_step"] == 4
+        assert metrics["dstpu_window_samples_per_sec"] > 0
+        assert metrics["dstpu_fleet_reported_hosts"] == 1
+        assert metrics["dstpu_healthy"] == 1
+        code, _ = _get(base + "/nope")
+        assert code == 404
+    except urllib.error.HTTPError as err:
+        if err.code != 404:
+            raise
+    finally:
+        srv.close()
+
+
+def test_healthz_degrades_on_watchdog_fire():
+    """A fired watchdog flips /healthz to 503: alive but wedged is the
+    state an orchestrator must replace."""
+    e = _engine(_cfg(obs={"report_window": 2}))
+    srv = health_mod.HealthServer(0, e.telemetry, rank=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert _get(base + "/healthz")[0] == 200
+        COUNTERS.watchdog_fires += 1
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(base + "/healthz")
+        assert exc.value.code == 503
+    finally:
+        srv.close()
+
+
+def test_resolve_health_port_env_and_offset(monkeypatch):
+    from deepspeed_tpu.observability.health import (ENV_HEALTH_PORT,
+                                                    resolve_health_port)
+    monkeypatch.delenv(ENV_HEALTH_PORT, raising=False)
+    assert resolve_health_port(0) is None
+    assert resolve_health_port(8090) == 8090 + jax.process_index()
+    monkeypatch.setenv(ENV_HEALTH_PORT, "9100")
+    assert resolve_health_port(0) == 9100 + jax.process_index()
+    # config beats env
+    assert resolve_health_port(8090) == 8090 + jax.process_index()
+    monkeypatch.setenv(ENV_HEALTH_PORT, "junk")
+    assert resolve_health_port(0) is None
+
+
+def test_prometheus_text_round_trip():
+    # small/negative/non-finite values render via %g — the parser must
+    # accept every rendering the emitter produces (1e-05 once failed a
+    # hand-rolled char class)
+    text = health_mod.prometheus_text(
+        {"a/b": 1.5, "skip_none": None, "bool_skipped": True, "c": 2,
+         "tiny": 1e-05, "neg": -2.5, "inf": float("inf")},
+        labels={"rank": 1})
+    parsed = health_mod.parse_prometheus_text(text)
+    assert parsed["dstpu_a_b"] == 1.5 and parsed["dstpu_c"] == 2.0
+    assert parsed["dstpu_tiny"] == 1e-05
+    assert parsed["dstpu_neg"] == -2.5
+    assert parsed["dstpu_inf"] == float("inf")
+    with pytest.raises(ValueError, match="malformed"):
+        health_mod.parse_prometheus_text("not a metric line at all")
+    with pytest.raises(ValueError, match="malformed"):
+        health_mod.parse_prometheus_text("dstpu_x{rank=\"0\"} junkvalue")
+
+
+# ------------------------------------------------------------- schema v2 / CLI
+
+def _valid_fleet_event():
+    ev = {"schema": schema.FLEET_SCHEMA_ID, "version": 2, "ts": 1.0,
+          "window": 1, "step": 4, "n_hosts": 2, "reported_hosts": 2,
+          "missing_hosts": [], "stragglers": [1],
+          "anomalies": [{"rank": 1, "kind": "loss_spike"}],
+          "skipped_total": 0, "counters": {"resilience/nan_skips": 0},
+          "per_host": {"0": {}, "1": {}}}
+    for name in schema.FLEET_FIELDS:
+        ev.setdefault(name, None)
+    return ev
+
+
+def test_fleet_event_schema_validation():
+    ev = _valid_fleet_event()
+    assert schema.validate_fleet_event(ev) is None
+    assert schema.validate_any(ev) is None
+    assert "reported_hosts" in schema.validate_fleet_event(
+        {**ev, "reported_hosts": 3})
+    assert "stragglers" in schema.validate_fleet_event(
+        {**ev, "stragglers": ["one"]})
+    assert "anomalies" in schema.validate_fleet_event(
+        {**ev, "anomalies": ["loss_spike"]})
+    assert "version" in schema.validate_fleet_event({**ev, "version": 1})
+
+
+def test_window_schema_v1_still_accepted():
+    """PR 7 logs (version 1, no v2 fields) must keep validating — the
+    fleet columns are additive."""
+    v1 = {"schema": schema.SCHEMA_ID, "version": 1, "ts": 1.0, "step": 3,
+          "window_steps": 3, "skipped": 0, "counters": {}}
+    for name, spec in schema.FIELDS.items():
+        if len(spec) < 3:       # v1 fields only
+            v1.setdefault(name, None)
+    assert schema.validate_event(v1) is None
+    # ...but a v2 event MISSING the fleet columns is invalid
+    v2 = dict(v1, version=2)
+    assert "missing field" in schema.validate_event(v2)
+
+
+def test_validator_cli_mixed_stream_and_exit_codes(tmpdir, capsys):
+    """The validator accepts mixed window/fleet/startup streams and still
+    exits 2 on invalid or empty files — the pinned CI gate."""
+    mixed = str(tmpdir.join("mixed.jsonl"))
+    e = _engine(_cfg(obs={"report_window": 2, "jsonl_path": mixed,
+                          "fleet": True}))
+    for i in range(4):
+        e.train_batch(_batch(i))
+    e.flush_telemetry()
+    assert obs_cli.main([mixed]) == 0
+    out = capsys.readouterr().out
+    # the summary names every schema present in the stream
+    assert "window" in out and "fleet" in out and "startup" in out
+
+    unknown = str(tmpdir.join("unknown.jsonl"))
+    with open(unknown, "w") as f:
+        f.write(json.dumps({"schema": "dstpu.telemetry.nonsense",
+                            "version": 9}) + "\n")
+    assert obs_cli.main([unknown]) == 2
+    err = capsys.readouterr().err
+    assert "unknown schema" in err
+
+    empty = str(tmpdir.join("empty.jsonl"))
+    open(empty, "w").close()
+    assert obs_cli.main([empty]) == 2
+
+    # a stream mixing valid and invalid lines fails as a whole
+    half = str(tmpdir.join("half.jsonl"))
+    with open(half, "w") as f:
+        with open(mixed) as src:
+            f.write(src.readline())
+        f.write("not json\n")
+    assert obs_cli.main([half]) == 2
+
+
+# --------------------------------------------------------------- config guards
+
+def test_fleet_config_validation():
+    with pytest.raises(DeepSpeedConfigError, match="fleet"):
+        _engine(_cfg(obs={"fleet": True}))      # needs report_window
+    with pytest.raises(DeepSpeedConfigError, match="straggler_factor"):
+        _engine(_cfg(obs={"report_window": 2, "straggler_factor": 1.0}))
+    with pytest.raises(DeepSpeedConfigError, match="health_port"):
+        _engine(_cfg(obs={"health_port": 99999}))
+    with pytest.raises(DeepSpeedConfigError, match="starvation_frac"):
+        _engine(_cfg(obs={"report_window": 2, "starvation_frac": 0.0}))
+    with pytest.raises(DeepSpeedConfigError, match="flight_recorder"):
+        _engine(_cfg(obs={"flight_recorder": -1}))
+    with pytest.raises(DeepSpeedConfigError, match="fleet_wait_s"):
+        _engine(_cfg(obs={"report_window": 2, "fleet": True,
+                          "fleet_wait_s": 0}))
+    with pytest.raises(DeepSpeedConfigError, match="unknown observability"):
+        _engine(_cfg(obs={"flet": True}))
+
+
+def test_launcher_health_port_flag():
+    from deepspeed_tpu.launcher import launch, run
+    args = run.parse_args(["--health_port", "8090", "script.py"])
+    assert args.health_port == 8090
+    largs = launch.parse_args(["--world_info", run.encode_world_info(
+        {"localhost": [0]}), "--health_port", "8090", "x.py"])
+    assert largs.health_port == 8090

@@ -191,7 +191,17 @@ def test_window_events_schema_and_partial_flush(tmpdir):
     e.flush_telemetry()
     e.flush_telemetry()             # idempotent: no duplicate windows
     assert schema.validate_jsonl(jsonl) == []
-    events = [json.loads(l) for l in open(jsonl)]
+    lines = [json.loads(l) for l in open(jsonl)]
+    # exactly one startup event, BEFORE the first window event — the
+    # cold-start cost is a recorded number, not a missing one
+    assert [ev["schema"] for ev in lines[:2]] == [
+        schema.STARTUP_SCHEMA_ID, schema.SCHEMA_ID]
+    startups = [ev for ev in lines
+                if ev["schema"] == schema.STARTUP_SCHEMA_ID]
+    assert len(startups) == 1
+    assert startups[0]["time_to_first_step_s"] > 0
+    assert startups[0]["first_dispatch_s"] > 0      # contains compile
+    events = [ev for ev in lines if ev["schema"] == schema.SCHEMA_ID]
     assert [ev["window_steps"] for ev in events] == [3, 3, 2]
     assert [ev["step"] for ev in events] == [3, 6, 8]
     # every boundary is covered exactly once — no dropped final window
@@ -201,9 +211,14 @@ def test_window_events_schema_and_partial_flush(tmpdir):
     assert events[0]["step_ms"] is None
     assert events[1]["step_ms"] > 0
     assert events[1]["samples_per_sec"] > 0
+    # v2 per-host columns present on every window event
+    assert events[0]["rank"] == 0
+    assert events[1]["host_ms"] >= 0
+    assert events[0]["anomalies"] == []
     # the registry snapshot rides every event
     assert "resilience/nan_skips" in events[0]["counters"]
     assert "samples/lr" in events[0]["counters"]
+    assert "observability/stragglers_flagged" in events[0]["counters"]
 
 
 def test_planner_drift_columns(tmpdir):
@@ -217,7 +232,8 @@ def test_planner_drift_columns(tmpdir):
     for i in range(4):
         e.train_batch(_batch(i))
     e.flush_telemetry()
-    events = [json.loads(l) for l in open(jsonl)]
+    events = [json.loads(l) for l in open(jsonl)
+              if json.loads(l)["schema"] == schema.SCHEMA_ID]
     assert events[0]["measured_boundary_ms"] == 12.5
     assert events[0]["boundary_drift"] == pytest.approx(
         12.5 / events[0]["predicted_boundary_ms"], rel=1e-3)
@@ -378,9 +394,17 @@ def test_preemption_drain_flushes_final_window(tmpdir, monkeypatch):
         resilience.run_resumable(factory, train_step, steps=10,
                                  save_dir=str(tmpdir.join("ck")))
     assert exc.value.code == resilience.RESUME_EXIT_CODE
-    events = [json.loads(l) for l in open(jsonl)]
+    events = [json.loads(l) for l in open(jsonl)
+              if json.loads(l)["schema"] == schema.SCHEMA_ID]
     assert sum(ev["window_steps"] for ev in events) == 2
     assert schema.validate_jsonl(jsonl) == []
+    # the drain also left a flight-recorder dump naming the drained step
+    from deepspeed_tpu.observability import flightrec
+    dump_path = str(tmpdir.join("flightrec_rank0_preempt.json"))
+    payload = flightrec.load_dump(dump_path)
+    assert payload["reason"] == "preempt"
+    assert any(en["kind"] == "preempt_agreed" and en["step"] == 2
+               for en in payload["entries"])
 
 
 # ----------------------------------------------------------- exporter dedupe

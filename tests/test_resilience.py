@@ -356,12 +356,17 @@ def test_watchdog_abort_exit_code(tmpdir):
     assert "stuck step" in proc.stderr
 
 
-def test_engine_stall_injection_fires_watchdog():
+def test_engine_stall_injection_fires_watchdog(tmpdir):
     """The env/config-keyed stall lands INSIDE the engine's armed boundary
-    region: the watchdog sees a hung collective and the dump names both
-    the stuck frame and the armed label."""
+    region: the watchdog sees a hung collective and the dump names the
+    stuck frame, the armed label AND — via the flight-recorder tail —
+    the exact step the process stalled at, plus a loadable dump file
+    (docs/observability.md "Flight recorder")."""
+    from deepspeed_tpu.observability import flightrec
+
     cfg = dict(NAN_CFG)
     cfg["resilience"] = {"watchdog_timeout_s": 0.3}
+    cfg["observability"] = {"flight_recorder_dir": str(tmpdir)}
     engine = _engine_factory(cfg)()
     engine._watchdog.poll_s = 0.05
     chaos.configure(stall_step=1, stall_s=1.5)
@@ -371,6 +376,16 @@ def test_engine_stall_injection_fires_watchdog():
     assert wd.fired
     assert "chaos_stall" in wd.last_dump
     assert "optimizer boundary step" in wd.last_dump
+    # dump enrichment: the recorder tail names the stalled step (the last
+    # armed entry is the boundary that never completed)
+    assert "recent flight-recorder entries:" in wd.last_dump
+    assert "arm label=boundary step=1" in wd.last_dump
+    # ...and the ring was persisted as a loadable post-mortem artifact
+    payload = flightrec.load_dump(
+        str(tmpdir.join("flightrec_rank0_watchdog.json")))
+    assert payload["reason"] == "watchdog"
+    assert payload["entries"][-1]["kind"] == "arm"
+    assert payload["entries"][-1]["step"] == 1
     assert COUNTERS.watchdog_fires >= 1
 
 
