@@ -25,6 +25,8 @@ schedule sweep, BENCH_ATTN_SWEEP=1 for the attention-kernel sweep,
 BENCH_HEAD=1 for the MLM-head sparse-vs-dense microbench (CPU-safe),
 BENCH_OVERLAP=1 for the ZeRO boundary comm/compute-overlap microbench
 (CPU-safe: parity + bucket-count evidence; see bench_overlap.json),
+BENCH_SERVE=1 for the serving bench (continuous vs static batching,
+tokens/s/chip + p50/p99 TTFT/ITL -> bench_serve.json),
 BENCH_RESUME=1 for the time-to-first-step-after-relaunch bench (serial vs
 parallel streaming restore + cold vs warm persistent compile cache;
 CPU-safe; see bench_resume.json),
@@ -1434,6 +1436,17 @@ def run_resume_bench(tmpdir=None):
     e_par.load_checkpoint(ckpt_dir, tag="resume")
     rows["restore_parallel_s"] = round(time.perf_counter() - t0, 3)
 
+    # weights-only fast path (the serving cold start): same reader
+    # pipeline, but optimizer/ZeRO partitions are never read —
+    # docs/resilience.md "Time to resume" carries this row next to the
+    # full restores
+    from deepspeed_tpu import checkpoint as _ckpt
+    t0 = time.perf_counter()
+    _tag, _tree = _ckpt.load_params_only(ckpt_dir, tag="resume",
+                                         dtype="bfloat16")
+    rows["restore_params_only_s"] = round(time.perf_counter() - t0, 3)
+    del _tree
+
     # a relaunched process has no in-memory executables — drop ours so the
     # restored engine's first step goes to the persistent cache
     jax.clear_caches()
@@ -1448,6 +1461,21 @@ def run_resume_bench(tmpdir=None):
             "the persistent compilation cache (hits stayed at "
             f"{COUNTERS.compile_cache_hits}) — the relaunch would pay a "
             "full recompile")
+    if not np.isfinite(loss):
+        # fail LOUDLY: a non-finite loss from a bitwise-restored state
+        # means the cache-deserialized executable computed garbage, and a
+        # garbage artifact must never be committed silently.  Known
+        # trigger: some jax 0.4.x XLA-CPU builds lose donation aliasing
+        # when deserializing donated-buffer executables.
+        raise RuntimeError(
+            f"BENCH_RESUME: resumed loss is {loss} on a bitwise-restored "
+            "state — the persistent-cache deserialized executable is "
+            "computing garbage (known on jax 0.4.x XLA-CPU with donated "
+            "buffers).  Rerun with DSTPU_NO_DONATE=1 to measure on this "
+            "rig; the artifact records the switch")
+    rows["donation"] = ("off (DSTPU_NO_DONATE=1)"
+                        if os.environ.get("DSTPU_NO_DONATE") == "1"
+                        else "on")
 
     rows["time_to_first_step_cold_s"] = round(
         rows["restore_serial_s"] + rows["compile_cold_s"], 3)
@@ -1466,6 +1494,125 @@ def run_resume_bench(tmpdir=None):
                     "persistent-cache deserialize.  warm_cache_hits > 0 "
                     "is the proof the restarted step skipped recompilation"),
            **rows})
+    return 0
+
+
+def _bench_serve(jsonl_dir=None):
+    """Serving throughput/latency under synthetic heavy traffic
+    (BENCH_SERVE=1): continuous batching vs the static baseline on the
+    SAME deterministic request trace, greedy sampling, identical outputs
+    asserted — so the comparison is pure scheduling, not generation
+    luck.  Reports tokens/s/chip and p50/p99 time-to-first-token /
+    inter-token latency for both schedulers plus an int8-quantized
+    continuous leg; one JSON line → bench_serve.json.
+
+    Env knobs: BENCH_SIZE (gpt2 size, default tiny on CPU / small on
+    TPU), BENCH_SERVE_SLOTS (8), BENCH_SERVE_REQUESTS (32),
+    BENCH_SERVE_TOKENS (per-slot cache capacity, 128),
+    BENCH_SERVE_DTYPE (float32 on CPU / bfloat16 on TPU)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deepspeed_tpu.inference import (InferenceEngine, StaticScheduler,
+                                         latency_summary, run_serve,
+                                         synthetic_requests)
+    from deepspeed_tpu.models.gpt2 import GPT2
+
+    on_tpu = jax.default_backend() == "tpu"
+    size = os.environ.get("BENCH_SIZE", "small" if on_tpu else "tiny")
+    vocab = int(os.environ.get("BENCH_VOCAB", "512"))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "32"))
+    max_tokens = int(os.environ.get("BENCH_SERVE_TOKENS", "128"))
+    dtype = os.environ.get("BENCH_SERVE_DTYPE",
+                           "bfloat16" if on_tpu else "float32")
+    bucket = min(64, max_tokens)
+    root = jsonl_dir or tempfile.mkdtemp(prefix="dstpu_serve_bench_")
+
+    def build(quantize=None):
+        model = GPT2.from_size(size, vocab_size=vocab,
+                               max_seq_len=max_tokens)
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "inference": {"max_slots": slots, "max_tokens": max_tokens,
+                             "prefill_bucket": bucket, "page_tokens": 32,
+                             "dtype": dtype, "quantize": quantize}}
+        return InferenceEngine(model, config=cfg, seed=0)
+
+    # decode-heavy mixed-length trace: generation-length VARIANCE is what
+    # static batching pays for (every batch decodes to its longest member)
+    trace = synthetic_requests(
+        n_req, vocab=vocab, seed=0, prompt_min=2,
+        prompt_max=max(8, bucket // 4), new_min=4,
+        new_max=int(os.environ.get("BENCH_SERVE_NEW_MAX", "48")))
+
+    engine = build()
+    # per-chip accounting uses the ENGINE's mesh (one replica = mp chips;
+    # other devices on the host would serve other replicas)
+    n_chips = len(engine.mesh.devices.flat)
+    n_params = _count_params(engine.params)
+    # warm the executables out of the timed region (both schedulers use
+    # the same two programs, so neither side pays compile)
+    engine.generate([trace[0].prompt], max_new_tokens=2)
+    engine.reset()
+
+    cont = run_serve(engine, trace,
+                     jsonl_path=os.path.join(root, "serve.jsonl"),
+                     window_iters=16)
+    cont_sum, cont_results = cont["summary"], cont["results"]
+
+    engine.reset()
+    static = StaticScheduler(engine)
+    t0 = time.perf_counter()
+    static_results = static.run(trace)
+    static_sum = latency_summary(static_results,
+                                 time.perf_counter() - t0, n_chips)
+    static_sum["decode_iters"] = static.decode_iters
+
+    # same trace, same greedy sampler => identical generations, or the
+    # comparison is meaningless
+    by_rid = {r.rid: r.tokens for r in cont_results}
+    for r in static_results:
+        if by_rid[r.rid] != r.tokens:
+            raise RuntimeError(
+                f"BENCH_SERVE: request {r.rid} generated differently "
+                f"under continuous vs static scheduling — the batching "
+                f"invariance contract is broken")
+
+    engq = build(quantize="int8")
+    engq.generate([trace[0].prompt], max_new_tokens=2)
+    engq.reset()
+    int8 = run_serve(engq, trace, window_iters=16)["summary"]
+
+    beats = (cont_sum["tokens_per_sec"] is not None
+             and static_sum["tokens_per_sec"] is not None
+             and cont_sum["tokens_per_sec"] >= static_sum["tokens_per_sec"]
+             and (cont_sum["ttft_p99_ms"] or 0)
+             <= (static_sum["ttft_p99_ms"] or 0))
+    if not beats:
+        print("BENCH_SERVE: WARNING — continuous batching did not beat "
+              "static batching on this rig (wall-clock contention noise "
+              "on virtual-CPU hosts; rerun or use a chip)",
+              file=sys.stderr)
+
+    if not jsonl_dir:
+        shutil.rmtree(root, ignore_errors=True)
+    _emit({"metric": "serve_tokens_per_sec_per_chip",
+           "value": cont_sum["tokens_per_sec_per_chip"],
+           "unit": "tokens/s/chip (continuous batching, greedy)",
+           "platform": jax.default_backend(),
+           "device_kind": jax.devices()[0].device_kind,
+           "n_chips": n_chips, "n_params": n_params,
+           "model": size, "dtype": dtype, "slots": slots,
+           "requests": n_req, "max_tokens": max_tokens,
+           "prefill_bucket": bucket,
+           "continuous": cont_sum, "static": static_sum, "int8": int8,
+           "continuous_beats_static": bool(beats),
+           "note": ("identical greedy outputs asserted across schedulers; "
+                    "static decodes every batch until its last member "
+                    "finishes, continuous admits into freed slots each "
+                    "iteration — the delta is pure scheduling")})
     return 0
 
 
@@ -1512,6 +1659,8 @@ def main():
         return run_ckpt_bench()
     if os.environ.get("BENCH_RESUME", "0") == "1":
         return run_resume_bench()
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        return _bench_serve()
     if os.environ.get("BENCH_MFU_BREAKDOWN", "0") == "1":
         return run_mfu_breakdown()
     if os.environ.get("BENCH_OPT", "0") == "1":

@@ -75,6 +75,14 @@ def _infer_family(path: str, override: str) -> str:
     if override != "auto":
         return override
     base = path.lower()
+    import re
+    tokens = re.split(r"[^a-z0-9]+", os.path.basename(base))
+    if "serve" in tokens or "serving" in tokens:
+        # serving config (FILENAME tokens only — a substring test would
+        # misroute "server/", "preserve" or "observed"): gate the
+        # INFERENCE engine's prefill + decode programs instead of a
+        # train step (docs/inference.md)
+        return "serve"
     if "bert" in base:
         return "bert"
     if "gpt" in base:
@@ -173,6 +181,39 @@ def _build_model(family: str, seq_len: int, config_path: str = ""):
     return model, make_batch
 
 
+def _analyze_serve_config(path: str, cfg: dict, an_cfg, suppress,
+                          plan: bool = False, profile: str = None):
+    """Serve-config analysis: build a tiny GPT-2 InferenceEngine on the
+    config (gating sections stripped — the CLI dispatches itself) and
+    lint/plan its PREFILL + DECODE programs.  The serving analog of the
+    train-step gate — ``--plan`` adds the capacity table with the
+    persistent KV-cache line."""
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2
+
+    # auto slot sizing needs the profile; everything else gates via the
+    # CLI's own dispatch, so keep only the profile from the section
+    if an_cfg and an_cfg.get("profile") and "analysis" not in cfg:
+        cfg["analysis"] = {"profile": an_cfg["profile"]}
+    model = GPT2.from_size("tiny")
+    try:
+        engine = InferenceEngine(model, config=cfg)
+        rep = engine.run_graph_lint()
+        cap = None
+        if plan:
+            from deepspeed_tpu.analysis import profiles as prof_mod
+            prof = (prof_mod.resolve(profile) if profile
+                    else prof_mod.default_profile())
+            cap = engine.plan_capacity(profile=prof)
+            rep.extend(cap.to_report(subject="serve"))
+    finally:
+        from deepspeed_tpu.utils import compile_cache
+        if compile_cache.enabled_dir() is not None:
+            compile_cache.disable()
+    rep.subject = f"{path} (model=serve)"
+    return rep.filtered(suppress), cap
+
+
 def _analyze_config(path: str, family: str, seq_len: int, suppress,
                     plan: bool = False, profile: str = None):
     """(filtered lint Report, CapacityPlan | None) for one config."""
@@ -186,8 +227,11 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress,
     # the CLI decides lint/plan dispatch itself; the engine must not also
     # raise on its own config keys
     cfg.pop("graph_lint", None)
-    cfg.pop("analysis", None)
+    an_cfg = cfg.pop("analysis", None)
     family = _infer_family(path, family)
+    if family == "serve":
+        return _analyze_serve_config(path, cfg, an_cfg, suppress,
+                                     plan=plan, profile=profile)
     model, make_batch = _build_model(family, seq_len, config_path=path)
     cap = None
     try:
@@ -234,10 +278,12 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=("warn", "error"), default="warn",
                     help="'error': exit 2 on error-severity findings "
                          "(the CI gate); 'warn' (default): report only")
-    ap.add_argument("--model", choices=("auto", "mlp", "gpt2", "bert"),
+    ap.add_argument("--model",
+                    choices=("auto", "mlp", "gpt2", "bert", "serve"),
                     default="auto",
                     help="representative model family (default: inferred "
-                         "from the config path)")
+                         "from the config path; 'serve' gates the "
+                         "inference engine's prefill/decode programs)")
     ap.add_argument("--seq-len", type=int, default=64,
                     help="sequence length for the synthetic batch")
     ap.add_argument("--suppress", action="append", default=[],

@@ -472,6 +472,12 @@ class CapacityPlan:
                 f"{pers['optimizer_state_bytes'] / 2**20:.2f}Mi + grad-acc "
                 f"{pers['grad_accumulator_bytes'] / 2**20:.2f}Mi "
                 f"(zero_stage={pers['zero_stage']})")
+            if "kv_cache_bytes" in pers:
+                # serving plans (inference/engine.py) carry the
+                # preallocated KV cache as a persistent line item
+                lines.append(
+                    f"kv cache: {pers['kv_cache_bytes'] / 2**20:.2f}Mi "
+                    f"preallocated")
         if self.zero3_prefetch_bytes:
             lines.append(
                 f"zero3 prefetch transient: "

@@ -1286,6 +1286,68 @@ def load_module_tree(load_dir: str, tag: Optional[str] = None, specs=None):
                                  _state_axes(saved_pp, saved_mp))
 
 
+def load_params_only(load_dir: str, tag: Optional[str] = None, specs=None,
+                     dtype=None, threads: int = 0,
+                     readahead_mb: float = 256.0, io_retries: int = 3):
+    """Weights-only restore fast path: just the module tree, streamed
+    through the PR 5 parallel reader — the serving cold-start read
+    (deepspeed_tpu/inference/, docs/inference.md).
+
+    Skips every optimizer/ZeRO partition: the stage-1/2 flat-state
+    ``zero_pp_rank_*`` shard records are NEVER opened (regression-pinned
+    in tests/test_inference.py), and a stage-3 shard-native checkpoint
+    reads only the ``param`` chunks of its per-dp shard files (masters
+    and moments stay untouched on disk — the container format memmaps
+    per chunk, so unread fields cost nothing).
+
+    ``specs`` (the saving model's ``partition_specs()``) is required when
+    the checkpoint was written at mp>1 or pp>1, like
+    :func:`load_module_tree`.  ``dtype`` casts every floating leaf on
+    the host as it lands (the serving engine loads fp32 masters' module
+    copies straight into bf16).  ``threads=0`` auto-sizes the reader
+    pool; 1 is the serial fallback running the identical plan.
+
+    Returns ``(tag, host_tree)``; ``None`` when no valid checkpoint
+    exists under ``load_dir``.
+    """
+    ASYNC_SAVER.wait()
+    plan = _RestorePlan(
+        threads=(threads if threads > 0 else _RestorePlan.auto_threads()),
+        readahead_mb=readahead_mb, io_retries=io_retries)
+    read = _read_model_states(load_dir, tag, lazy=True)
+    if read is None:
+        return None
+    tag, states, saved_mp, saved_pp = read
+    if saved_mp * saved_pp == 1:
+        module = states[0]["module"]
+    else:
+        if specs is None:
+            raise ValueError(
+                f"checkpoint was saved at mp={saved_mp}, pp={saved_pp}: "
+                "pass specs (the saving model's partition_specs) so "
+                "sharded leaves can be reassembled")
+        module = _combine_shard_states([s["module"] for s in states],
+                                       specs, _state_axes(saved_pp, saved_mp),
+                                       lazy=True)
+    np_dtype = None if dtype is None else np.dtype(dtype)
+
+    def _cast(arr):
+        arr = np.asarray(arr)
+        if np_dtype is None or not (
+                np.issubdtype(arr.dtype, np.floating)
+                or arr.dtype == jnp.bfloat16):
+            return arr
+        return arr.astype(np_dtype)
+
+    leaves, treedef = jax.tree_util.tree_flatten(module)
+    stream = _stream_leaves(leaves, plan)
+    try:
+        out = [_cast(h) for h in stream]
+    finally:
+        stream.close()
+    return tag, treedef.unflatten(out)
+
+
 def _zero3_rehydrate(load_dir: str, tag: str, states, lazy: bool = False):
     """Replace stage-3 partition markers in freshly read model states with
     full-along-data leaves reassembled from the per-(row, dp) shard files

@@ -634,6 +634,75 @@ class DeepSpeedConfig:
                 f"must be a directory string, got "
                 f"{self.observability_flight_recorder_dir!r}")
 
+        # inference serving: KV-cache layout/sizing, prefill bucket,
+        # compute dtype, int8 weight quantization
+        # (deepspeed_tpu/inference/, docs/inference.md)
+        inf = pd.get(C.INFERENCE, None)
+        if inf is not None and not isinstance(inf, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.INFERENCE}' must be a JSON object, got {inf!r}")
+        inf_known = {C.INFERENCE_MAX_SLOTS, C.INFERENCE_MAX_TOKENS,
+                     C.INFERENCE_PREFILL_BUCKET, C.INFERENCE_KV_LAYOUT,
+                     C.INFERENCE_PAGE_TOKENS, C.INFERENCE_DTYPE,
+                     C.INFERENCE_QUANTIZE}
+        if inf is not None and set(inf) - inf_known:
+            # a typo'd serving knob would silently serve with defaults —
+            # loud, like the resilience section
+            raise DeepSpeedConfigError(
+                f"unknown {C.INFERENCE} key(s) "
+                f"{sorted(set(inf) - inf_known)}; supported: "
+                f"{sorted(inf_known)}")
+
+        def _inf_int(key, default):
+            val = get_scalar_param(inf, key, default)
+            try:
+                return int(val)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{key} must be an integer, got {val!r}")
+
+        self.inference_max_slots = _inf_int(
+            C.INFERENCE_MAX_SLOTS, C.INFERENCE_MAX_SLOTS_DEFAULT)
+        if self.inference_max_slots < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_MAX_SLOTS} must be >= 0 "
+                f"(0 = auto-size against the analysis profile)")
+        self.inference_max_tokens = _inf_int(
+            C.INFERENCE_MAX_TOKENS, C.INFERENCE_MAX_TOKENS_DEFAULT)
+        if self.inference_max_tokens < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_MAX_TOKENS} must be >= 0 "
+                f"(0 = the model's max_seq_len)")
+        self.inference_prefill_bucket = _inf_int(
+            C.INFERENCE_PREFILL_BUCKET, C.INFERENCE_PREFILL_BUCKET_DEFAULT)
+        if self.inference_prefill_bucket < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PREFILL_BUCKET} must be >= 0 "
+                f"(0 = the cache capacity)")
+        self.inference_kv_layout = get_scalar_param(
+            inf, C.INFERENCE_KV_LAYOUT, C.INFERENCE_KV_LAYOUT_DEFAULT)
+        if self.inference_kv_layout not in ("paged", "ring"):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_KV_LAYOUT} must be 'paged' "
+                f"or 'ring', got {self.inference_kv_layout!r}")
+        self.inference_page_tokens = _inf_int(
+            C.INFERENCE_PAGE_TOKENS, C.INFERENCE_PAGE_TOKENS_DEFAULT)
+        if self.inference_page_tokens < 1:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PAGE_TOKENS} must be >= 1")
+        self.inference_dtype = get_scalar_param(
+            inf, C.INFERENCE_DTYPE, C.INFERENCE_DTYPE_DEFAULT)
+        if not isinstance(self.inference_dtype, str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_DTYPE} must be a dtype name "
+                f"string, got {self.inference_dtype!r}")
+        self.inference_quantize = get_scalar_param(
+            inf, C.INFERENCE_QUANTIZE, C.INFERENCE_QUANTIZE_DEFAULT)
+        if self.inference_quantize not in (None, "int8"):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_QUANTIZE} must be null or "
+                f"'int8', got {self.inference_quantize!r}")
+
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)
         prof = pd.get(C.PROFILE, None) or {}

@@ -273,6 +273,39 @@ OBSERVABILITY_FLIGHT_RECORDER_DIR = "flight_recorder_dir"
 OBSERVABILITY_FLIGHT_RECORDER_DIR_DEFAULT = None
 
 #############################################
+# Inference serving (TPU-native: deepspeed_tpu/inference/,
+# docs/inference.md.  No reference analog: v0.1.0 is training-only —
+# an inference engine is on its "explicitly absent" list.)
+#############################################
+INFERENCE = "inference"
+# concurrent decode slots (continuous batching width); 0 = auto-size
+# against the analysis profile's HBM after weights (kvcache.plan_slots)
+INFERENCE_MAX_SLOTS = "max_slots"
+INFERENCE_MAX_SLOTS_DEFAULT = 4
+# per-slot KV-cache token capacity (page-rounded); 0 = the model's
+# max_seq_len
+INFERENCE_MAX_TOKENS = "max_tokens"
+INFERENCE_MAX_TOKENS_DEFAULT = 0
+# fixed prompt padding bucket of the prefill program (one executable
+# serves every prompt); 0 = the cache capacity
+INFERENCE_PREFILL_BUCKET = "prefill_bucket"
+INFERENCE_PREFILL_BUCKET_DEFAULT = 0
+# "paged" (exact up to capacity) | "ring" (sliding window: the cache row
+# wraps — approximate beyond capacity, documented in docs/inference.md)
+INFERENCE_KV_LAYOUT = "kv_layout"
+INFERENCE_KV_LAYOUT_DEFAULT = "paged"
+# cache allocation granularity in tokens
+INFERENCE_PAGE_TOKENS = "page_tokens"
+INFERENCE_PAGE_TOKENS_DEFAULT = 128
+# serving compute dtype: "bfloat16" (default) | "float16" | "float32"
+INFERENCE_DTYPE = "dtype"
+INFERENCE_DTYPE_DEFAULT = "bfloat16"
+# weight quantization at load: null | "int8" (per-output-channel scales,
+# matmul-dequant dispatch table — inference/quant.py)
+INFERENCE_QUANTIZE = "quantize"
+INFERENCE_QUANTIZE_DEFAULT = None
+
+#############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming
 # restore — checkpoint.py, docs/resilience.md "Time to resume".  No
 # reference analog: v0.1.0 saves/loads synchronously through torch.save.)

@@ -1582,7 +1582,17 @@ class DeepSpeedTpuEngine:
         planner read, so the planner's output-aliasing model can never
         drift from the compiled donation.  fp32 compute skips donating
         params/master (fused) or master (split): their output buffers may
-        alias through the identity cast (see the builder comments)."""
+        alias through the identity cast (see the builder comments).
+
+        ``DSTPU_NO_DONATE=1`` disables donation everywhere — a debugging
+        escape hatch (costs one extra copy of the donated state in HBM).
+        The concrete case that needed it: some jax 0.4.x XLA-CPU builds
+        deserialize donated-buffer executables from the persistent
+        compile cache with broken aliasing, so a cache-HIT step silently
+        computes garbage — bench.py's resume leg detects the garbage and
+        names this switch."""
+        if os.environ.get("DSTPU_NO_DONATE", "") == "1":
+            return ()
         if fused:
             return ((2, 3) if self.policy.compute_dtype == jnp.float32
                     else (0, 1, 2, 3))

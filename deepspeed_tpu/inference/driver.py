@@ -1,0 +1,176 @@
+"""Synthetic heavy-traffic driver + serve telemetry.
+
+The driver generates a deterministic request trace (seeded prompt/length
+mix — the "millions of users" stand-in every serving bench and CI smoke
+run replays identically), runs it through a scheduler, and reports the
+serving headline numbers: tokens/s/chip and p50/p99 time-to-first-token
+and inter-token latency.
+
+Telemetry rides the PR 7/9 machinery unchanged: window events
+(``dstpu.telemetry.serve`` v1, one line per window of decode
+iterations) and the cold-start startup event
+(``dstpu.telemetry.startup`` v2, carrying ``restore_seconds`` and
+compile-cache hit/miss counters exactly like the training event) are
+emitted through :class:`~deepspeed_tpu.observability.registry.JsonlSink`
+and validated by the same ``python -m deepspeed_tpu.observability``
+CLI (schema.py is version-aware across all four schemas).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.inference.scheduler import (ContinuousScheduler, Request,
+                                               latency_samples_ms,
+                                               latency_summary, percentile)
+
+logger = logging.getLogger(__name__)
+
+
+def synthetic_requests(n: int, *, vocab: int, seed: int = 0,
+                       prompt_min: int = 4, prompt_max: int = 24,
+                       new_min: int = 4, new_max: int = 24,
+                       eos_id: Optional[int] = None) -> List[Request]:
+    """Deterministic mixed-length trace: uniform prompt lengths and
+    token budgets — the variance is what makes continuous batching win
+    (uniform-length traffic would let static batching tie)."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(prompt_min, prompt_max + 1))
+        prompt = rng.integers(0, vocab, size=plen).astype(int).tolist()
+        reqs.append(Request(
+            rid=i, prompt=prompt,
+            max_new_tokens=int(rng.integers(new_min, new_max + 1)),
+            eos_id=eos_id))
+    return reqs
+
+
+class ServeTelemetry:
+    """Windowed serve-event emitter: every ``window_iters`` scheduler
+    iterations fold into one ``dstpu.telemetry.serve`` line; the startup
+    event goes out once, at the first token (when restore latency and the
+    compile-cache counters are all known facts)."""
+
+    def __init__(self, engine, jsonl_path: Optional[str] = None,
+                 window_iters: int = 8):
+        if window_iters < 1:
+            raise ValueError("window_iters must be >= 1")
+        self.engine = engine
+        self.window_iters = int(window_iters)
+        self.sink = None
+        if jsonl_path:
+            from deepspeed_tpu.observability.registry import JsonlSink
+            self.sink = JsonlSink(jsonl_path)
+        self._startup_emitted = False
+        self._window = 0
+        self._reset_window()
+        self.last_event = None
+
+    def _reset_window(self):
+        self._iters = 0
+        self._tokens = 0
+        self._admitted = 0
+        self._active_sum = 0
+        self._queue_depth = 0
+        self._t0 = time.perf_counter()
+
+    def _emit(self, event: dict):
+        self.last_event = event
+        if self.sink is not None:
+            self.sink.emit(event)
+
+    def on_iteration(self, sched, stats: dict):
+        """Scheduler hook (``ContinuousScheduler(on_event=...)``)."""
+        if not self._startup_emitted and self.engine.first_token_ts:
+            self._startup_emitted = True
+            self._emit(self.engine.startup_event())
+        self._iters += 1
+        self._tokens += stats["tokens_out"]
+        self._admitted += stats["admitted"]
+        self._active_sum += stats["active"]
+        self._queue_depth = stats["queue_depth"]
+        if self._iters >= self.window_iters:
+            self.flush(sched)
+
+    def flush(self, sched):
+        """Emit the current (possibly partial) window; final partial
+        windows are part of the record, like the training spool's."""
+        if self._iters == 0:
+            return
+        from deepspeed_tpu.observability import schema
+        from deepspeed_tpu.resilience import COUNTERS
+        elapsed = time.perf_counter() - self._t0
+        # percentiles are CUMULATIVE over the run's completed requests
+        # (bench/CI traces are bounded and short traces need every
+        # sample for a stable tail; a long-lived replica would swap in
+        # reservoir sampling here to bound the per-window cost)
+        ttft, itl = latency_samples_ms(sched.results)
+        self._window += 1
+        spec = self.engine.cache_spec
+        from deepspeed_tpu.inference import kvcache
+        event = {
+            "schema": schema.SERVE_SCHEMA_ID,
+            "version": schema.SERVE_SCHEMA_VERSION,
+            "ts": time.time(),
+            "window": self._window,
+            "decode_iters": self._iters,
+            "tokens_out": self._tokens,
+            "admitted": self._admitted,
+            "evicted": sched.evicted,
+            "active_slots_mean": round(self._active_sum
+                                       / max(1, self._iters), 3),
+            "queue_depth": self._queue_depth,
+            "slots": spec.slots,
+            "kv_cache_gb": round(kvcache.cache_bytes(spec) / 2 ** 30, 6),
+            "tokens_per_sec": (round(self._tokens / elapsed, 3)
+                               if elapsed > 0 else None),
+            "ttft_p50_ms": percentile(ttft, 50),
+            "ttft_p99_ms": percentile(ttft, 99),
+            "itl_p50_ms": percentile(itl, 50),
+            "itl_p99_ms": percentile(itl, 99),
+            "counters": COUNTERS.as_dict(),
+        }
+        self._emit(event)
+        self._reset_window()
+
+    def close(self):
+        if self.sink is not None:
+            self.sink.close()
+
+
+def run_serve(engine, requests, *, jsonl_path: Optional[str] = None,
+              window_iters: int = 8, sampler=None) -> dict:
+    """Run ``requests`` through continuous batching with telemetry;
+    returns ``{"results", "summary"}`` where summary is
+    :func:`~deepspeed_tpu.inference.scheduler.latency_summary` plus the
+    scheduler's utilization counters."""
+    from deepspeed_tpu.inference.scheduler import greedy_sampler
+    tel = ServeTelemetry(engine, jsonl_path=jsonl_path,
+                         window_iters=window_iters)
+    sched = ContinuousScheduler(engine, sampler=sampler or greedy_sampler,
+                                on_event=tel.on_iteration)
+    t0 = time.perf_counter()
+    results = sched.run(requests)
+    elapsed = time.perf_counter() - t0
+    tel.flush(sched)
+    tel.close()
+    summary = latency_summary(results, elapsed,
+                              n_chips=len(engine.mesh.devices.flat))
+    summary.update({
+        "decode_iters": sched.decode_iters,
+        "admitted": sched.admitted,
+        "evicted": sched.evicted,
+        "slots": engine.num_slots,
+        "quantize": engine.quantize,
+        "dtype": str(np.dtype(engine.compute_dtype)),
+        "mp": engine.mp_world_size,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+    })
+    return {"results": results, "summary": summary}

@@ -1,0 +1,524 @@
+"""The serving engine: checkpoint → tokens.
+
+``InferenceEngine`` loads any training checkpoint (ZeRO-1/3, sync/async,
+stage-3 shard-native) through :func:`checkpoint.load_params_only` — the
+weights-only fast path over the PR 5 parallel streaming reader — places
+the weights on a tensor-parallel serving mesh (optionally int8-quantized
+at load, inference/quant.py), sizes a preallocated KV cache against the
+active :class:`~deepspeed_tpu.analysis.profiles.BackendProfile`
+(inference/kvcache.py), and compiles exactly TWO programs:
+
+* **prefill** — full-prompt forward for ONE request into a chosen cache
+  slot (fixed prompt bucket, so one executable serves every prompt), and
+* **decode**  — one incremental token step across ALL slots at once
+  (per-slot positions, EOS-agnostic — the scheduler owns eviction).
+
+Both programs are gated through graph lint and the capacity planner at
+build, exactly like the training step programs (``graph_lint`` /
+``analysis`` config sections; error mode raises at build).  The
+cold-start path is the PR 5 machinery: the persistent compile cache is
+enabled before either program traces, restore latency and cache
+hit/miss counters land in the serve startup event
+(``dstpu.telemetry.startup``) just as they do for training (PR 9).
+
+Scale-out model: ONE engine = one model replica (the mesh is the
+model-parallel group).  Data parallelism in serving is engine replicas
+behind a router, not a mesh axis — so batch-side tensors here are
+replicated and the only collectives are the model-axis psums the layers
+already issue.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu import analysis as graph_lint
+from deepspeed_tpu import checkpoint
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.inference import kvcache, quant
+from deepspeed_tpu.parallel.topology import MODEL_AXIS, make_mesh
+
+logger = logging.getLogger(__name__)
+
+_DTYPES = {
+    "float32": jnp.float32, "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float16": jnp.float16, "fp16": jnp.float16,
+}
+
+
+def _resolve_dtype(name):
+    try:
+        return _DTYPES[str(name).strip().lower()]
+    except KeyError:
+        raise DeepSpeedConfigError(
+            f"inference.dtype must be one of {sorted(set(_DTYPES))}, "
+            f"got {name!r}")
+
+
+class InferenceEngine:
+    """Checkpoint-to-tokens serving engine (docs/inference.md)."""
+
+    def __init__(self, model, config=None, mesh=None, params=None,
+                 checkpoint_dir: Optional[str] = None,
+                 tag: Optional[str] = None, seed: int = 0):
+        if model is None:
+            raise ValueError("InferenceEngine: model is required")
+        self.module = model
+        self._built_ts = time.time()
+        self.restore_seconds = None
+        self.first_token_ts = None
+        self.first_dispatch_s = None
+
+        cfg_src = config if config is not None else {}
+        if isinstance(cfg_src, str):
+            import json as _json
+            with open(cfg_src) as f:
+                cfg_src = _json.load(f)
+        cfg_src = dict(cfg_src)
+        # serving needs no batch triangle; satisfy the training-config
+        # invariant with a unit micro batch when none is declared
+        if not any(k in cfg_src for k in (
+                "train_batch_size", "train_micro_batch_size_per_gpu")):
+            cfg_src["train_micro_batch_size_per_gpu"] = 1
+        self.config = DeepSpeedConfig(cfg_src, dp_world_size=1)
+
+        # persistent compile cache BEFORE any program traces — a serving
+        # replica relaunch reuses the prior attempt's prefill/decode
+        # executables (the PR 5 cold-start machinery)
+        from deepspeed_tpu.utils import compile_cache as _compile_cache
+        self.compile_cache_dir = _compile_cache.enable_from_config(
+            self.config)
+
+        mp = int(self.config.model_parallel_size or 1)
+        if mesh is None:
+            devs = jax.devices()
+            if len(devs) < mp:
+                raise DeepSpeedConfigError(
+                    f"model_parallel_size={mp} needs {mp} devices, "
+                    f"{len(devs)} visible")
+            # the serving mesh IS the model-parallel group: extra devices
+            # belong to other replicas, not to a data axis
+            mesh = make_mesh(model_parallel_size=mp, devices=devs[:mp])
+        self.mesh = mesh
+        self.mp_world_size = mesh.shape[MODEL_AXIS]
+        validate_fn = getattr(model, "validate", None)
+        if validate_fn is not None:
+            validate_fn(self.mp_world_size)
+
+        self.compute_dtype = _resolve_dtype(self.config.inference_dtype)
+        self.quantize = self.config.inference_quantize
+
+        # ---- weights: checkpoint fast path / host tree / fresh init ----
+        specs = model.partition_specs()
+        host = None
+        if checkpoint_dir is not None:
+            t0 = time.perf_counter()
+            loaded = checkpoint.load_params_only(
+                checkpoint_dir, tag=tag, specs=specs,
+                dtype=self.compute_dtype,
+                threads=self.config.checkpoint_restore_threads,
+                readahead_mb=self.config.checkpoint_restore_readahead_mb,
+                io_retries=self.config.resilience_io_retries)
+            if loaded is None:
+                raise FileNotFoundError(
+                    f"no valid checkpoint under {checkpoint_dir!r}")
+            self.loaded_tag, host = loaded
+            self.restore_seconds = time.perf_counter() - t0
+            from deepspeed_tpu.resilience import COUNTERS
+            COUNTERS.restore_seconds = self.restore_seconds
+            logger.info("serve restore: tag %s in %.2fs (params-only)",
+                        self.loaded_tag, self.restore_seconds)
+        elif params is not None:
+            host = jax.tree_util.tree_map(
+                lambda l: np.asarray(l, self._np_dtype(l)), params)
+            self.loaded_tag = None
+        else:
+            host = jax.tree_util.tree_map(
+                lambda l: np.asarray(l, self._np_dtype(l)),
+                model.init_params(jax.random.PRNGKey(seed)))
+            self.loaded_tag = None
+
+        if self.quantize == "int8":
+            host = quant.quantize_tree(host, self.compute_dtype)
+            specs = quant.quantize_specs(specs)
+        self._param_specs = specs
+        self.params = self._place(host, specs)
+        self.weight_bytes = self._per_device_bytes(self.params, specs)
+
+        # ---- KV cache sized against the active backend profile ----
+        from deepspeed_tpu.analysis import profiles as prof_mod
+        # the EXPLICITLY chosen profile (analysis.profile) sizes budgets;
+        # the running backend's profile only shapes the memory model —
+        # an implicit cpu-8 must never become a surprise budget (the
+        # PR 6 report-only contract)
+        self._explicit_profile = (
+            prof_mod.resolve(self.config.analysis_profile)
+            if self.config.analysis_profile else None)
+        self.profile = (self._explicit_profile
+                        or prof_mod.default_profile())
+        max_tokens = (self.config.inference_max_tokens
+                      or getattr(model.config, "max_seq_len", 1024))
+        model_max_seq = getattr(model.config, "max_seq_len", None)
+        if model_max_seq is not None:
+            # clamp capacity to the model's position range: rows past
+            # max_seq_len can never be written (the schedulers reject
+            # requests beyond it), so they would be dead HBM the memplan
+            # gate still prices — and auto slot sizing would divide the
+            # budget by the inflated per-slot bytes
+            max_tokens = min(int(max_tokens), int(model_max_seq))
+        self.cache_spec = kvcache.spec_from_model(
+            model, self.mp_world_size,
+            slots=self.config.inference_max_slots,
+            max_tokens=max_tokens, dtype=self.compute_dtype,
+            layout=self.config.inference_kv_layout,
+            page_tokens=self.config.inference_page_tokens,
+            hbm_bytes=(self._explicit_profile.hbm_bytes
+                       if self._explicit_profile is not None else None),
+            weight_bytes=self.weight_bytes)
+        max_seq = getattr(model.config, "max_seq_len", None)
+        # default bucket: the cache capacity, clipped to the model's
+        # position range — page-rounding may push capacity PAST
+        # max_seq_len (max_seq 50 → capacity 128), and the engine's own
+        # default must not trip the guards below
+        default_bucket = (min(self.cache_spec.capacity, int(max_seq))
+                          if max_seq is not None
+                          else self.cache_spec.capacity)
+        self.prefill_bucket = (self.config.inference_prefill_bucket
+                               or default_bucket)
+        if self.prefill_bucket > self.cache_spec.capacity:
+            raise DeepSpeedConfigError(
+                f"inference.prefill_bucket ({self.prefill_bucket}) cannot "
+                f"exceed the per-slot cache capacity "
+                f"({self.cache_spec.capacity})")
+        if max_seq is not None and self.prefill_bucket > max_seq:
+            raise DeepSpeedConfigError(
+                f"inference.prefill_bucket ({self.prefill_bucket}) exceeds "
+                f"the model's max_seq_len ({max_seq})")
+        self._cache_specs = kvcache.cache_partition_specs()
+        self._cache = self._place(kvcache.init_cache(self.cache_spec),
+                                  self._cache_specs)
+
+        # ---- the two compiled programs, lint- and memplan-gated ----
+        self._prefill_fn = self._build_prefill()
+        self._decode_fn = self._build_decode()
+        self._gate_programs()
+
+    # ------------------------------------------------------------ helpers
+    @property
+    def num_slots(self) -> int:
+        return self.cache_spec.slots
+
+    def _np_dtype(self, leaf):
+        dt = np.asarray(leaf).dtype
+        if np.issubdtype(dt, np.floating) or dt == jnp.bfloat16:
+            return np.dtype(self.compute_dtype)
+        return dt
+
+    def _place(self, host_tree, specs):
+        leaves, td = jax.tree_util.tree_flatten(host_tree)
+        spec_leaves = td.flatten_up_to(specs)
+        graph_lint.validate_specs_or_raise(self.mesh, specs, host_tree,
+                                           where="serve params")
+        placed = [jax.device_put(np.asarray(l),
+                                 NamedSharding(self.mesh, s))
+                  for l, s in zip(leaves, spec_leaves)]
+        return td.unflatten(placed)
+
+    def _per_device_bytes(self, tree, specs) -> int:
+        """Weight bytes ONE device holds: sharded dims divide by the mesh
+        axes they map to."""
+        total = 0
+        leaves, td = jax.tree_util.tree_flatten(tree)
+        spec_leaves = td.flatten_up_to(specs)
+        for leaf, spec in zip(leaves, spec_leaves):
+            n = int(leaf.nbytes)
+            for entry in spec:
+                if entry is None:
+                    continue
+                for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                    n //= max(1, int(self.mesh.shape.get(ax, 1)))
+            total += n
+        return total
+
+    def _donate_argnums(self):
+        """Cache buffers (k, v, pos) are donated in both programs — the
+        single source the builders AND the capacity planner read.  XLA-CPU
+        cannot donate (it would warn per program), so donation is
+        accelerator-only; the planner models whatever this returns."""
+        return (1, 2, 3) if jax.default_backend() != "cpu" else ()
+
+    # ------------------------------------------------------------ programs
+    def _build_prefill(self):
+        model = self.module
+        bucket = self.prefill_bucket
+        spec = self.cache_spec
+
+        def local(params, k, v, pos, tokens, slot, length):
+            # tokens [1, bucket]; slot/length int32 scalars
+            logits, ks, vs = model.apply_prefill(
+                params, tokens, jnp.reshape(length, (1,)))
+            pad = spec.capacity - bucket
+            if pad:
+                ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+                vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+            oh = (jnp.arange(spec.slots, dtype=jnp.int32) == slot)
+            ohc = oh.astype(k.dtype)[None, :, None, None, None]
+            k = k * (1 - ohc) + ks.astype(k.dtype) * ohc
+            v = v * (1 - ohc) + vs.astype(v.dtype) * ohc
+            pos = jnp.where(oh, length, pos)
+            return logits, k, v, pos
+
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._param_specs, self._cache_specs["k"],
+                      self._cache_specs["v"], P(), P(), P(), P()),
+            out_specs=(P(None, MODEL_AXIS), self._cache_specs["k"],
+                       self._cache_specs["v"], P()),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=self._donate_argnums())
+
+    def _build_decode(self):
+        model = self.module
+        ring = self.cache_spec.ring
+
+        def local(params, k, v, pos, tokens, active):
+            return model.apply_decode(params, tokens, k, v, pos, active,
+                                      ring=ring)
+
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._param_specs, self._cache_specs["k"],
+                      self._cache_specs["v"], P(), P(), P()),
+            out_specs=(P(None, MODEL_AXIS), self._cache_specs["k"],
+                       self._cache_specs["v"], P()),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=self._donate_argnums())
+
+    def _program_args(self, kind: str):
+        """Example argument tuples for tracing (lint + planner) — shapes
+        only, no execution."""
+        shapes = kvcache.cache_jax_shapes(self.cache_spec)
+        k, v = shapes["k"], shapes["v"]
+        pos = shapes["pos"]
+        if kind == "prefill":
+            return (self.params, k, v, pos,
+                    jax.ShapeDtypeStruct((1, self.prefill_bucket),
+                                         jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32))
+        return (self.params, k, v, pos,
+                jax.ShapeDtypeStruct((self.cache_spec.slots,), jnp.int32),
+                jax.ShapeDtypeStruct((self.cache_spec.slots,), jnp.bool_))
+
+    def run_graph_lint(self) -> graph_lint.Report:
+        """Jaxpr passes over BOTH serving programs (the CLI/test surface,
+        ignoring ``graph_lint.mode``)."""
+        mesh_axes = list(self.mesh.shape.keys())
+        rep = graph_lint.Report(subject="serve")
+        for kind, fn in (("prefill", self._prefill_fn),
+                         ("decode", self._decode_fn)):
+            closed = jax.make_jaxpr(fn)(*self._program_args(kind))
+            rep.extend(graph_lint.analyze_jaxpr(
+                closed, mesh_axes=mesh_axes, subject=kind))
+        return rep.filtered(self.config.graph_lint_suppress)
+
+    def plan_capacity(self, profile=None, budget_gb=None):
+        """Static capacity plan of the prefill + decode programs plus the
+        persistent weights + KV cache — the serving analog of
+        ``DeepSpeedTpuEngine.plan_capacity``."""
+        from deepspeed_tpu.analysis import memplan
+        from deepspeed_tpu.analysis import profiles as prof_mod
+        # budget only from an EXPLICITLY chosen profile (caller arg or
+        # config) — the running backend's implicit profile still shapes
+        # the memory model but must not gate (PR 6 report-only contract)
+        explicit = profile if profile is not None else self._explicit_profile
+        if profile is None:
+            profile = self._explicit_profile or self.profile
+        if budget_gb is None:
+            budget_gb = self.config.analysis_memory_budget_gb
+        budget_bytes = (int(float(budget_gb) * (1 << 30))
+                        if budget_gb is not None else None)
+        if budget_bytes is None and explicit is not None:
+            budget_bytes = explicit.hbm_bytes
+        programs = []
+        for kind, fn in (("prefill", self._prefill_fn),
+                         ("decode", self._decode_fn)):
+            programs.append(memplan.analyze_program(
+                fn, self._program_args(kind),
+                donate_argnums=self._donate_argnums(),
+                subject=kind, profile=profile))
+        # same key set the training plan's persistent table prints, plus
+        # the serving-only KV cache line
+        persistent = {
+            "params_bytes": self.weight_bytes,
+            "optimizer_state_bytes": 0,
+            "grad_accumulator_bytes": 0,
+            "zero_stage": 0,
+            "kv_cache_bytes": kvcache.cache_bytes(self.cache_spec),
+        }
+        return memplan.CapacityPlan(programs=programs,
+                                    persistent=persistent,
+                                    profile=profile,
+                                    budget_bytes=budget_bytes)
+
+    def _gate_programs(self):
+        """Build-time gates, one per program family, dispatched exactly
+        like the training engine's (`graph_lint.mode` / `analysis.mode`;
+        error mode raises before the first request)."""
+        mode = self.config.graph_lint_mode
+        if mode != "off":
+            try:
+                rep = self.run_graph_lint()
+            except graph_lint.GraphLintError:
+                raise
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("graph lint could not analyze the serve "
+                               "programs: %s", e)
+                rep = None
+            if rep is not None:
+                graph_lint.dispatch_report(rep, mode, where="serve",
+                                           log=logger)
+        amode = self.config.analysis_mode
+        if amode != "off":
+            try:
+                plan = self.plan_capacity()
+                rep = plan.to_report(subject="serve").filtered(
+                    self.config.analysis_suppress)
+            except graph_lint.GraphLintError:
+                raise
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("capacity plan could not analyze the serve "
+                               "programs: %s", e)
+                rep = None
+            if rep is not None:
+                graph_lint.dispatch_report(
+                    rep, amode, where="serve", log=logger,
+                    label="capacity plan",
+                    info_hint="engine.plan_capacity().format_table() "
+                              "shows the plan",
+                    error_cls=graph_lint.MemoryPlanError)
+
+    def max_total_tokens(self):
+        """Hard per-request budget (prompt + generated): positions past
+        the model's ``max_seq_len`` would silently reuse the last
+        position embedding, and a PAGED cache clamps its write row at
+        capacity — both would break the exactness contract, so the
+        schedulers reject over-budget requests at submit time.  The ring
+        layout is only capacity-unbounded (its documented sliding
+        window); the position-embedding bound still applies."""
+        vals = []
+        if not self.cache_spec.ring:
+            vals.append(self.cache_spec.capacity)
+        max_seq = getattr(self.module.config, "max_seq_len", None)
+        if max_seq is not None:
+            vals.append(int(max_seq))
+        return min(vals) if vals else None
+
+    # ------------------------------------------------------------- serving
+    def reset(self):
+        """Clear every slot.  The old cache buffers are released BEFORE
+        the fresh zeroed cache is placed — a planner-sized cache fills
+        most of HBM, so holding both copies transiently could OOM the
+        exact configurations the planner approved."""
+        self._cache = None
+        self._cache = self._place(kvcache.init_cache(self.cache_spec),
+                                  self._cache_specs)
+
+    def prefill(self, slot: int, prompt_tokens) -> np.ndarray:
+        """Prefill ``prompt_tokens`` into cache ``slot``; returns the
+        full-vocab logits row of the last prompt token (the first
+        generated token's distribution)."""
+        toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if toks.size < 1:
+            raise ValueError("prefill: empty prompt")
+        if toks.size > self.prefill_bucket:
+            raise ValueError(
+                f"prompt of {toks.size} tokens exceeds the prefill bucket "
+                f"({self.prefill_bucket}) — raise "
+                f"inference.prefill_bucket/max_tokens")
+        if not (0 <= int(slot) < self.num_slots):
+            raise ValueError(f"slot {slot} outside [0, {self.num_slots})")
+        padded = np.zeros((1, self.prefill_bucket), np.int32)
+        padded[0, :toks.size] = toks
+        t0 = time.perf_counter()
+        logits, k, v, pos = self._prefill_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            self._cache["pos"], padded, np.int32(slot),
+            np.int32(toks.size))
+        out = np.asarray(logits, np.float32)[0]
+        self._cache = {"k": k, "v": v, "pos": pos}
+        if self.first_token_ts is None:
+            self.first_token_ts = time.time()
+            self.first_dispatch_s = time.perf_counter() - t0
+        return out
+
+    def decode(self, tokens, active) -> np.ndarray:
+        """One decode iteration over every slot: ``tokens`` int32
+        [slots] (this step's input token per slot), ``active`` bool
+        [slots].  Returns full-vocab logits [slots, vocab] (inactive
+        rows are meaningless); per-slot positions advance by ``active``."""
+        logits, k, v, pos = self._decode_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            self._cache["pos"], np.asarray(tokens, np.int32),
+            np.asarray(active, bool))
+        self._cache = {"k": k, "v": v, "pos": pos}
+        return np.asarray(logits, np.float32)
+
+    def slot_positions(self) -> np.ndarray:
+        return np.asarray(self._cache["pos"])
+
+    # ---------------------------------------------------------- telemetry
+    def startup_event(self) -> dict:
+        """The serve cold-start record — same schema (and meaning) as the
+        PR 9 training startup event: ``time_to_first_step_s`` is build →
+        first TOKEN, ``first_dispatch_s`` the first prefill dispatch
+        (compile-dominated on a cold cache), plus restore latency and
+        compile-cache counters (docs/inference.md "Cold start")."""
+        import socket
+        from deepspeed_tpu.observability import schema
+        from deepspeed_tpu.resilience import COUNTERS
+        return {
+            "schema": schema.STARTUP_SCHEMA_ID,
+            "version": 2,
+            "ts": time.time(),
+            "rank": jax.process_index(),
+            "host": socket.gethostname(),
+            "step": 0,
+            "time_to_first_step_s": (
+                round(self.first_token_ts - self._built_ts, 4)
+                if self.first_token_ts is not None else None),
+            "first_dispatch_s": (round(self.first_dispatch_s, 4)
+                                 if self.first_dispatch_s is not None
+                                 else None),
+            "restore_seconds": (round(self.restore_seconds, 4)
+                                if self.restore_seconds is not None
+                                else None),
+            "compile_cache_hits": COUNTERS.compile_cache_hits,
+            "compile_cache_misses": COUNTERS.compile_cache_misses,
+        }
+
+    # --------------------------------------------------------- convenience
+    def generate(self, prompts, max_new_tokens: int = 16, eos_id=None,
+                 sampler=None):
+        """Greedy-generate for a list of token-id prompts via the
+        continuous-batching scheduler; returns generated-token lists in
+        prompt order."""
+        from deepspeed_tpu.inference.scheduler import (ContinuousScheduler,
+                                                       Request,
+                                                       greedy_sampler)
+        sched = ContinuousScheduler(self, sampler=sampler or greedy_sampler)
+        reqs = [Request(rid=i, prompt=list(p),
+                        max_new_tokens=max_new_tokens, eos_id=eos_id)
+                for i, p in enumerate(prompts)]
+        results = sched.run(reqs)
+        by_rid = {r.rid: r.tokens for r in results}
+        return [by_rid[i] for i in range(len(reqs))]

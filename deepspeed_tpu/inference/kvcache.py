@@ -1,0 +1,177 @@
+"""KV-cache management for the serving engine.
+
+The cache is a pair of preallocated per-layer buffers stacked on the
+layer axis — ``k``/``v``: ``[L, slots, capacity, n_local_heads, d]`` —
+plus per-slot ``pos`` bookkeeping, living on device for the whole
+serving session.  Two layouts (``inference.kv_layout``):
+
+* ``paged`` (default): capacity is the per-request token budget rounded
+  up to whole pages (``page_tokens``); positions never wrap, so
+  incremental decode is EXACT vs a full-context re-forward up to the
+  budget (the oracle contract, docs/inference.md).
+* ``ring``: the cache row wraps (``pos % capacity``) — a sliding
+  attention window of the last ``capacity`` tokens.  Exactness holds
+  only while a request's length stays within capacity; beyond it the
+  window is a documented approximation.
+
+Sizing is ARITHMETIC, not trial-and-error: :func:`cache_bytes` is the
+exact buffer cost, and :func:`plan_slots` solves for the slot count that
+fits the active :class:`~deepspeed_tpu.analysis.profiles.BackendProfile`
+HBM after weights — the PR 6 capacity-planner handoff.  The engine's
+``plan_capacity()`` additionally walks the compiled prefill/decode
+programs (analysis/memplan.py) so transients are predicted too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+LAYOUTS = ("paged", "ring")
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Resolved shape of the serving KV cache on ONE model shard."""
+    layers: int
+    slots: int                   # concurrent decode slots
+    capacity: int                # tokens per slot (page-rounded)
+    kv_heads_local: int          # heads held by this model shard
+    head_dim: int
+    mp_size: int = 1             # model-parallel degree (global heads =
+                                 # kv_heads_local * mp_size)
+    dtype: object = jnp.bfloat16
+    layout: str = "paged"
+    page_tokens: int = 128
+
+    def __post_init__(self):
+        if self.layout not in LAYOUTS:
+            raise ValueError(
+                f"kv_layout must be one of {LAYOUTS}, got {self.layout!r}")
+        if self.slots < 1 or self.capacity < 1:
+            raise ValueError(
+                f"KV cache needs slots >= 1 and capacity >= 1 (got "
+                f"slots={self.slots}, capacity={self.capacity})")
+
+    @property
+    def ring(self) -> bool:
+        return self.layout == "ring"
+
+    @property
+    def pages_per_slot(self) -> int:
+        return -(-self.capacity // max(1, self.page_tokens))
+
+    @property
+    def global_shape(self):
+        """Shape of the (mesh-global) k/v buffers — the heads dim carries
+        every model shard's heads; shard_map hands each rank its slice."""
+        return (self.layers, self.slots, self.capacity,
+                self.kv_heads_local * self.mp_size, self.head_dim)
+
+
+def round_to_pages(tokens: int, page_tokens: int) -> int:
+    """Capacity rounded UP to whole pages (the allocation granularity)."""
+    page_tokens = max(1, int(page_tokens))
+    return -(-int(tokens) // page_tokens) * page_tokens
+
+
+def cache_bytes(spec: KVCacheSpec) -> int:
+    """Exact per-device bytes of the k + v buffers (pos bookkeeping is
+    noise)."""
+    per_tok = spec.kv_heads_local * spec.head_dim
+    return (2 * spec.layers * spec.slots * spec.capacity * per_tok
+            * np.dtype(spec.dtype).itemsize)
+
+
+def plan_slots(layers: int, kv_heads_local: int, head_dim: int,
+               capacity: int, dtype, *, hbm_bytes: int,
+               weight_bytes: int, headroom_frac: float = 0.1,
+               slot_cap: int = 256) -> int:
+    """Max decode slots that fit: ``(HBM·(1-headroom) - weights) /
+    per-slot-bytes``, capped at ``slot_cap`` (beyond a few hundred slots
+    decode is MXU-bound, not memory-bound — more slots only add latency).
+    Raises when not even one slot fits — a serving config that cannot
+    hold a single request must fail at build, not OOM on the first
+    prompt."""
+    per_slot = (2 * layers * capacity * kv_heads_local * head_dim
+                * np.dtype(dtype).itemsize)
+    budget = int(hbm_bytes * (1.0 - headroom_frac)) - int(weight_bytes)
+    slots = budget // per_slot if per_slot > 0 else 0
+    if slots < 1:
+        raise ValueError(
+            f"KV cache does not fit: {weight_bytes / 2**30:.2f} GiB of "
+            f"weights + {per_slot / 2**20:.1f} MiB per slot exceed "
+            f"{hbm_bytes / 2**30:.2f} GiB HBM (headroom "
+            f"{headroom_frac:.0%}) — lower max_tokens, quantize, or use "
+            f"a bigger profile")
+    return int(min(slots, slot_cap))
+
+
+def init_cache(spec: KVCacheSpec):
+    """Zeroed (mesh-global) cache state: ``{"k", "v", "pos"}``.
+    ``pos[s]`` is slot s's NEXT absolute position (0 = empty); inactive
+    slots keep pos frozen."""
+    return {
+        "k": jnp.zeros(spec.global_shape, spec.dtype),
+        "v": jnp.zeros(spec.global_shape, spec.dtype),
+        "pos": jnp.zeros((spec.slots,), jnp.int32),
+    }
+
+
+def cache_partition_specs():
+    """Mesh shardings of the cache state: K/V shard their HEADS dim over
+    the model axis (each tensor-parallel rank caches exactly the heads it
+    computes); bookkeeping is replicated."""
+    return {
+        "k": P(None, None, None, MODEL_AXIS, None),
+        "v": P(None, None, None, MODEL_AXIS, None),
+        "pos": P(),
+    }
+
+
+def spec_from_model(model, mp_size: int, *, slots: int, max_tokens: int,
+                    dtype, layout: str = "paged",
+                    page_tokens: int = 128,
+                    hbm_bytes: Optional[int] = None,
+                    weight_bytes: int = 0) -> KVCacheSpec:
+    """Build the cache spec for an engine-protocol LM: dims from the
+    model's ``kv_cache_dims`` hook, capacity page-rounded, and — when
+    ``slots`` is 0 ("auto") — the slot count solved against the profile's
+    HBM via :func:`plan_slots`."""
+    dims_fn = getattr(model, "kv_cache_dims", None)
+    if dims_fn is None:
+        raise ValueError(
+            f"{type(model).__name__} does not expose kv_cache_dims(mp) — "
+            f"KV-cached serving needs the per-shard (layers, kv_heads, "
+            f"head_dim) declaration (models/gpt2.py)")
+    layers, kv_heads_local, head_dim = dims_fn(mp_size)
+    capacity = round_to_pages(max_tokens, page_tokens)
+    if slots in (0, None):
+        if hbm_bytes is None:
+            raise ValueError(
+                "inference.max_slots=0 (auto) needs a backend profile to "
+                "size against — set analysis.profile (docs/inference.md)")
+        slots = plan_slots(layers, kv_heads_local, head_dim, capacity,
+                           dtype, hbm_bytes=hbm_bytes,
+                           weight_bytes=weight_bytes)
+    return KVCacheSpec(layers=layers, slots=int(slots), capacity=capacity,
+                       kv_heads_local=kv_heads_local, head_dim=head_dim,
+                       mp_size=int(mp_size), dtype=dtype, layout=layout,
+                       page_tokens=page_tokens)
+
+
+def cache_jax_shapes(spec: KVCacheSpec):
+    """ShapeDtypeStructs of the (mesh-global) cache state (planner
+    tracing)."""
+    return {
+        "k": jax.ShapeDtypeStruct(spec.global_shape, spec.dtype),
+        "v": jax.ShapeDtypeStruct(spec.global_shape, spec.dtype),
+        "pos": jax.ShapeDtypeStruct((spec.slots,), jnp.int32),
+    }

@@ -1,0 +1,111 @@
+"""int8 weight-only quantization at checkpoint load (serving).
+
+Weights quantize on the HOST, on the GLOBAL tree, BEFORE device
+placement: per-output-channel symmetric int8 (``q = round(w / s)``,
+``s = max|w| / 127`` over the contraction axis), so the scale of every
+output channel is identical on every tensor-parallel rank — which is
+what lets the row-parallel matmul dequantize per shard and still psum
+correctly (models/layers.py ``row_parallel_linear``).
+
+A quantized leaf becomes a ``{"q": int8, "s": compute-dtype keepdims
+scale}`` subtree; the model's linear primitives detect it
+(``layers.is_quantized``) and dispatch through the matmul-dequant table
+(``layers.quant_matmul_plan``, env ``DSTPU_QUANT_MATMUL``).  Exactness
+contract (docs/inference.md "Quantization"): int8 serving is NOT
+bit-exact — the pinned guarantee is relative logit error within the
+documented tolerance vs the same-dtype unquantized engine, and
+"scaled" vs "dequant" impls agreeing within float rounding.
+
+What quantizes (GPT-2 family): the four block matmuls (``qkv_w``,
+``proj_w``, ``fc_w``, ``fc2_w``; per-layer per-output-channel scales on
+the stacked [L, ...] leaves) and the tied embedding/LM-head ``wte``
+(per-row scales — the row is both the embedding output channel and the
+logit output channel).  LayerNorms, biases and ``wpe`` stay in the
+serving compute dtype: they are O(hidden) bytes, and int8 there buys
+nothing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import layers as L
+
+#: engine-protocol leaf name -> contraction axis reduced by the scale
+#: (the OTHER >=1-sized axis is the output channel).  Stacked block
+#: leaves carry a leading layer axis the scale keeps.
+GPT2_QUANT_PLAN = {
+    "qkv_w": 1,      # [L, in, out] -> scale [L, 1, out]
+    "proj_w": 1,
+    "fc_w": 1,
+    "fc2_w": 1,
+    "wte": 1,        # [vocab, hid] -> scale [vocab, 1] (per-row)
+}
+
+
+def quantize_leaf(w, reduce_axis: int, compute_dtype):
+    """Symmetric per-channel int8: returns ``{"q", "s"}`` with the scale
+    keepdims-shaped (broadcast-ready) in the COMPUTE dtype — dequant
+    lands directly in the serving dtype with no extra cast."""
+    w32 = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w32), axis=reduce_axis, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+    return {"q": q, "s": scale.astype(jnp.dtype(compute_dtype))}
+
+
+def quantize_tree(params, compute_dtype, plan=None):
+    """Quantize every leaf whose NAME is in ``plan`` (host trees only).
+    Returns the mixed tree: quantized subtrees + untouched leaves."""
+    plan = GPT2_QUANT_PLAN if plan is None else plan
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for name, sub in node.items():
+            if isinstance(sub, dict):
+                out[name] = walk(sub)
+            elif name in plan:
+                out[name] = quantize_leaf(sub, plan[name], compute_dtype)
+            else:
+                out[name] = sub
+        return out
+
+    if not isinstance(params, dict):
+        raise ValueError(
+            "int8 quantization expects a dict-shaped param tree (the "
+            "engine-protocol model family)")
+    return walk(params)
+
+
+def quantize_specs(specs, plan=None):
+    """PartitionSpec tree matching :func:`quantize_tree`'s output: the
+    int8 payload keeps the weight's spec; the keepdims scale keeps the
+    spec with the REDUCED dim unsharded (its size is 1)."""
+    plan = GPT2_QUANT_PLAN if plan is None else plan
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for name, sub in node.items():
+            if isinstance(sub, dict):
+                out[name] = walk(sub)
+            elif name in plan and isinstance(sub, P):
+                axis = plan[name]
+                entries = list(sub) + [None] * max(0, axis + 1 - len(sub))
+                entries[axis] = None
+                out[name] = {"q": sub, "s": P(*entries)}
+            else:
+                out[name] = sub
+        return out
+
+    return walk(specs)
+
+
+# re-export the dispatch-table surface next to the quantizer
+is_quantized = L.is_quantized
+quant_matmul_plan = L.quant_matmul_plan

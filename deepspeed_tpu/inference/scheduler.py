@@ -1,0 +1,319 @@
+"""Request scheduling: continuous batching vs the static baseline.
+
+Continuous batching (the serving default): every decode iteration runs
+ALL active slots as one compiled step, and between iterations the
+scheduler admits queued requests into whatever slots just freed
+(EOS / max-token eviction) — no slot ever idles waiting for the longest
+request in a "batch" to finish.  The static scheduler is the honest
+baseline the bench compares against: it forms fixed batches in arrival
+order and decodes each batch until its LAST member finishes, so short
+requests burn decode iterations producing nothing and later batches
+queue behind the stragglers.
+
+Per-slot bookkeeping is position/length arithmetic only — the KV cache
+itself lives on device (inference/kvcache.py) and each slot's attention
+is masked strictly by its own position, so a slot's output stream is
+IDENTICAL whether it shares iterations with 0 or ``slots-1`` neighbours
+(the batching-invariance pin in tests/test_inference.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request."""
+    rid: int
+    prompt: List[int]                 # prompt token ids (non-empty)
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None      # stop token (None = length-only)
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid}: max_new_tokens must be >= 1")
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Completed request: generated tokens + the latency facts the bench
+    aggregates (seconds, measured host-side at token delivery)."""
+    rid: int
+    tokens: List[int]
+    finish_reason: str                # "eos" | "length"
+    ttft_s: Optional[float] = None    # enqueue -> first token
+    itl_s: List[float] = dataclasses.field(default_factory=list)
+    prompt_len: int = 0
+
+
+def greedy_sampler(logits_row: np.ndarray) -> int:
+    """Deterministic argmax over the full-vocab logits row — the decode
+    oracle's sampler (docs/inference.md)."""
+    return int(np.argmax(logits_row))
+
+
+def percentile(xs, p: float) -> Optional[float]:
+    """Nearest-rank percentile (None on empty) — shared by the latency
+    report and the bench leg."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = max(0, min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1)))))
+    return float(xs[k])
+
+
+def latency_samples_ms(results):
+    """``(ttft_ms, itl_ms)`` sample lists over completed results — the
+    one owner of the seconds→ms aggregation (latency_summary AND the
+    serve telemetry windows read it)."""
+    return ([r.ttft_s * 1e3 for r in results if r.ttft_s is not None],
+            [dt * 1e3 for r in results for dt in r.itl_s])
+
+
+def latency_summary(results, elapsed_s: float, n_chips: int = 1) -> dict:
+    """tokens/s(/chip) + p50/p99 TTFT and inter-token latency over a
+    completed trace (milliseconds, like the telemetry events)."""
+    ttft, itl = latency_samples_ms(results)
+    tokens = sum(len(r.tokens) for r in results)
+    tps = tokens / elapsed_s if elapsed_s > 0 else None
+    return {
+        "requests": len(results),
+        "tokens_out": tokens,
+        "elapsed_s": round(elapsed_s, 4),
+        "tokens_per_sec": None if tps is None else round(tps, 2),
+        "tokens_per_sec_per_chip": (None if tps is None
+                                    else round(tps / max(1, n_chips), 2)),
+        "ttft_p50_ms": percentile(ttft, 50),
+        "ttft_p99_ms": percentile(ttft, 99),
+        "itl_p50_ms": percentile(itl, 50),
+        "itl_p99_ms": percentile(itl, 99),
+    }
+
+
+def _stops(req: Request, tok: int, n_generated: int) -> bool:
+    return ((req.eos_id is not None and tok == req.eos_id)
+            or n_generated >= req.max_new_tokens)
+
+
+def _check_request(engine, req: Request) -> None:
+    """Submit-time admission checks: a bad request must be rejected
+    BEFORE it enters a drain, not explode mid-iteration and discard
+    every in-flight neighbour's work.  Two budgets: the prefill bucket
+    (prompt length) and the engine's total-token budget
+    (``max_total_tokens``: position-embedding range, plus paged-cache
+    capacity — past either, decode would silently clamp and the
+    exactness contract would break)."""
+    if len(req.prompt) > engine.prefill_bucket:
+        raise ValueError(
+            f"request {req.rid}: prompt of {len(req.prompt)} tokens "
+            f"exceeds the prefill bucket ({engine.prefill_bucket}) — "
+            f"raise inference.prefill_bucket/max_tokens")
+    budget = engine.max_total_tokens()
+    if budget is not None and len(req.prompt) + req.max_new_tokens > budget:
+        raise ValueError(
+            f"request {req.rid}: prompt ({len(req.prompt)}) + "
+            f"max_new_tokens ({req.max_new_tokens}) exceeds the "
+            f"per-request token budget ({budget} = min of paged cache "
+            f"capacity and the model's max_seq_len); shorten the "
+            f"request, raise inference.max_tokens, or use the ring "
+            f"layout's sliding window (docs/inference.md)")
+
+
+class _Slot:
+    """Host-side mirror of one decode slot."""
+
+    __slots__ = ("req", "generated", "last_token", "t_enqueue", "t_last",
+                 "ttft", "itl")
+
+    def __init__(self, req: Request, first_token: int, t_enqueue: float,
+                 now: float):
+        self.req = req
+        self.generated = [first_token]
+        self.last_token = first_token
+        self.t_enqueue = t_enqueue
+        self.t_last = now
+        self.ttft = now - t_enqueue
+        self.itl = []
+
+
+class ContinuousScheduler:
+    """Admit-into-free-slots continuous batching over one
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine`.
+
+    ``step()`` is one scheduler iteration: admission (prefill each newly
+    admitted request — its first token counts as TTFT), then ONE decode
+    program dispatch covering every active slot, then eviction.  Run to
+    drain with :meth:`run`."""
+
+    def __init__(self, engine, sampler: Callable = greedy_sampler,
+                 on_event: Optional[Callable] = None):
+        self.engine = engine
+        self.sampler = sampler
+        self.on_event = on_event          # telemetry hook (driver.py)
+        self.queue: List[tuple] = []      # (request, t_enqueue)
+        self.slots: List[Optional[_Slot]] = [None] * engine.num_slots
+        self.results: List[RequestResult] = []
+        self.decode_iters = 0
+        self.admitted = 0
+        self.evicted = 0
+
+    # ------------------------------------------------------------- intake
+    def submit(self, request: Request, now: Optional[float] = None):
+        _check_request(self.engine, request)
+        self.queue.append((request, time.perf_counter()
+                           if now is None else now))
+
+    @property
+    def active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def pending(self) -> int:
+        return len(self.queue)
+
+    # ------------------------------------------------------------ stepping
+    def step(self) -> dict:
+        """One scheduler iteration; returns the iteration's stats."""
+        eng = self.engine
+        admitted_now = 0
+        # 1) admission: fill free slots from the queue (every queued
+        # request already passed the submit-time budget checks)
+        for i in range(len(self.slots)):
+            if not self.queue or self.slots[i] is not None:
+                continue
+            req, t_enq = self.queue.pop(0)
+            logits = eng.prefill(i, req.prompt)
+            now = time.perf_counter()
+            tok = self.sampler(logits)
+            self.slots[i] = _Slot(req, tok, t_enq, now)
+            self.admitted += 1
+            admitted_now += 1
+            if _stops(req, tok, 1):
+                self._evict(i)
+
+        # 2) one decode iteration over every active slot
+        tokens_out = admitted_now
+        active_idx = [i for i, s in enumerate(self.slots) if s is not None]
+        if active_idx:
+            feed = np.zeros((len(self.slots),), np.int32)
+            for i in active_idx:
+                feed[i] = self.slots[i].last_token
+            active = np.zeros((len(self.slots),), bool)
+            active[active_idx] = True
+            logits = eng.decode(feed, active)
+            now = time.perf_counter()
+            self.decode_iters += 1
+            for i in active_idx:
+                s = self.slots[i]
+                tok = self.sampler(logits[i])
+                s.generated.append(tok)
+                s.itl.append(now - s.t_last)
+                s.t_last = now
+                s.last_token = tok
+                tokens_out += 1
+                if _stops(s.req, tok, len(s.generated)):
+                    self._evict(i)
+
+        return {
+            "admitted": admitted_now,
+            "tokens_out": tokens_out,
+            "active": len(active_idx),
+            "queue_depth": len(self.queue),
+        }
+
+    def _evict(self, slot_idx: int):
+        s = self.slots[slot_idx]
+        reason = ("eos" if s.req.eos_id is not None
+                  and s.generated[-1] == s.req.eos_id else "length")
+        self.slots[slot_idx] = None
+        self.evicted += 1
+        self.results.append(RequestResult(
+            rid=s.req.rid, tokens=list(s.generated), finish_reason=reason,
+            ttft_s=s.ttft, itl_s=list(s.itl),
+            prompt_len=len(s.req.prompt)))
+
+    def run(self, requests=None, max_iters: int = 100000) -> list:
+        """Drain: submit ``requests`` (optional) and iterate until every
+        slot and the queue are empty.  Returns results in completion
+        order."""
+        for r in (requests or []):
+            self.submit(r)
+        it = 0
+        while self.queue or self.active:
+            stats = self.step()
+            if self.on_event is not None:
+                self.on_event(self, stats)
+            it += 1
+            if it >= max_iters:
+                raise RuntimeError(
+                    f"scheduler did not drain in {max_iters} iterations "
+                    f"({self.active} active, {len(self.queue)} queued)")
+        return self.results
+
+
+class StaticScheduler:
+    """The baseline: fixed batches in arrival order, each decoded until
+    its LAST request finishes (finished slots keep burning iterations;
+    their extra tokens are discarded).  Shares the engine, sampler and
+    result shape with :class:`ContinuousScheduler` so the bench compares
+    exactly the same trace."""
+
+    def __init__(self, engine, sampler: Callable = greedy_sampler):
+        self.engine = engine
+        self.sampler = sampler
+        self.decode_iters = 0
+        self.results: List[RequestResult] = []
+
+    def run(self, requests) -> list:
+        eng = self.engine
+        n_slots = eng.num_slots
+        for r in requests:
+            _check_request(eng, r)
+        t0 = time.perf_counter()
+        enq = {r.rid: t0 for r in requests}
+        for start in range(0, len(requests), n_slots):
+            batch = requests[start:start + n_slots]
+            slots = {}
+            for i, req in enumerate(batch):
+                logits = eng.prefill(i, req.prompt)
+                now = time.perf_counter()
+                tok = self.sampler(logits)
+                slots[i] = _Slot(req, tok, enq[req.rid], now)
+            done = {i: _stops(s.req, s.last_token, 1)
+                    for i, s in slots.items()}
+            while not all(done.values()):
+                feed = np.zeros((n_slots,), np.int32)
+                active = np.zeros((n_slots,), bool)
+                for i, s in slots.items():
+                    feed[i] = s.last_token
+                    active[i] = True      # finished slots still decode —
+                    # the static baseline's waste is the point
+                logits = eng.decode(feed, active)
+                now = time.perf_counter()
+                self.decode_iters += 1
+                for i, s in slots.items():
+                    if done[i]:
+                        continue
+                    tok = self.sampler(logits[i])
+                    s.generated.append(tok)
+                    s.itl.append(now - s.t_last)
+                    s.t_last = now
+                    s.last_token = tok
+                    if _stops(s.req, tok, len(s.generated)):
+                        done[i] = True
+            for i, s in slots.items():
+                reason = ("eos" if s.req.eos_id is not None
+                          and s.generated[-1] == s.req.eos_id else "length")
+                self.results.append(RequestResult(
+                    rid=s.req.rid, tokens=list(s.generated),
+                    finish_reason=reason, ttft_s=s.ttft,
+                    itl_s=list(s.itl), prompt_len=len(s.req.prompt)))
+        return self.results

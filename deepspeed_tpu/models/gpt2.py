@@ -123,6 +123,60 @@ class GPT2:
             x, blocks, self.config, z3_dims=z3_dims,
             z3_prefetch=getattr(self, "zero3_prefetch", False)), 0.0
 
+    # ------------------------------------------------- serving (inference/)
+    def kv_cache_dims(self, mp_size: int = 1):
+        """(num_layers, local kv heads, head_dim) — what the serving KV
+        cache must hold per token on one model shard."""
+        cfg = self.config
+        return (cfg.num_layers, cfg.num_heads // mp_size,
+                cfg.hidden_size // cfg.num_heads)
+
+    def apply_prefill(self, params, tokens, length):
+        """Prefill forward (runs inside shard_map, like ``apply``).
+
+        tokens: int32 [B, P] left-aligned prompts padded to the prefill
+        bucket; length: int32 [B] real token counts.  Returns the
+        last-real-token logits [B, vocab/mp] (vocab-sharded) plus the
+        stacked per-layer K/V [L, B, P, n_local, d] for the cache.  Pad
+        rows' K/V are garbage but harmless: decode masks strictly by
+        position and overwrites each row before it becomes visible."""
+        cfg = self.config
+        B, P = tokens.shape
+        x = L.vocab_parallel_embedding(tokens, params["wte"])
+        x = x + L.seq_shard_positions(params["wpe"], P).astype(x.dtype)[None]
+        attn_mask = (jnp.arange(P, dtype=jnp.int32)[None, :]
+                     < length[:, None]).astype(jnp.float32)
+        x, ks, vs = T.stack_prefill(x, params["blocks"], cfg,
+                                    attn_mask=attn_mask,
+                                    cache_dtype=x.dtype)
+        x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
+        h_last = jnp.take_along_axis(
+            x, jnp.clip(length - 1, 0, P - 1)[:, None, None], axis=1)[:, 0]
+        return L.vocab_parallel_logits(h_last, params["wte"]), ks, vs
+
+    def apply_decode(self, params, tokens, k, v, pos, active,
+                     ring: bool = False):
+        """One incremental decode step (runs inside shard_map).
+
+        tokens: int32 [B] (this step's input token per slot); k/v:
+        [L, B, cap, n_local, d] caches; pos: int32 [B] absolute position
+        the new token occupies; active: bool [B] (inactive slots keep
+        their state — their logits are computed but meaningless).
+        Returns ``(logits [B, vocab/mp], k', v', pos')`` with
+        ``pos' = pos + active``."""
+        cfg = self.config
+        cap = k.shape[2]
+        write_idx = (pos % cap) if ring else jnp.clip(pos, 0, cap - 1)
+        x = L.vocab_parallel_embedding(tokens[:, None], params["wte"])
+        wpe = params["wpe"]
+        prow = jnp.take(wpe, jnp.clip(pos, 0, wpe.shape[0] - 1), axis=0)
+        x = x + prow[:, None].astype(x.dtype)
+        x, k, v = T.stack_decode(x, params["blocks"], cfg, k, v, pos,
+                                 write_idx, ring=ring)
+        x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
+        logits = L.vocab_parallel_logits(x[:, 0], params["wte"])
+        return logits, k, v, pos + active.astype(jnp.int32)
+
     def apply(self, params, tokens, labels):
         """tokens, labels: int32 [B, T]; labels < 0 are ignored.  Returns the
         mean per-token LM loss (fp32 scalar, local to the DP shard — the

@@ -172,8 +172,12 @@ def axis_size_or_1(axis) -> int:
 
 def column_parallel_linear(x, w_local, b_local=None):
     """x: [..., in] replicated over model axis; w_local: [in, out/mp].
-    Returns [..., out/mp] (sharded on the feature dim)."""
-    y = x @ w_local.astype(x.dtype)
+    Returns [..., out/mp] (sharded on the feature dim).  ``w_local`` may
+    be an int8-quantized subtree (serving — see ``matmul_dequant``)."""
+    if is_quantized(w_local):
+        y = matmul_dequant(x, w_local)
+    else:
+        y = x @ w_local.astype(x.dtype)
     if b_local is not None:
         y = y + b_local.astype(y.dtype)
     return y
@@ -181,8 +185,14 @@ def column_parallel_linear(x, w_local, b_local=None):
 
 def row_parallel_linear(x_local, w_local, b=None, axis=MODEL_AXIS):
     """x_local: [..., in/mp]; w_local: [in/mp, out].  psum completes the
-    contraction over the sharded input dim; result is replicated."""
-    y = jax.lax.psum(x_local @ w_local.astype(x_local.dtype), axis)
+    contraction over the sharded input dim; result is replicated.
+    Quantized weights dequantize per shard BEFORE the psum — per-output-
+    channel scales are identical on every model rank, so the reduction
+    is unchanged."""
+    if is_quantized(w_local):
+        y = jax.lax.psum(matmul_dequant(x_local, w_local), axis)
+    else:
+        y = jax.lax.psum(x_local @ w_local.astype(x_local.dtype), axis)
     if b is not None:
         y = y + b.astype(y.dtype)
     return y
@@ -194,6 +204,19 @@ def vocab_parallel_embedding(tokens, wte_local, axis=MODEL_AXIS):
     Masked local lookup + psum (Megatron VocabParallelEmbedding): each shard
     contributes rows it owns, zeros elsewhere.
     """
+    if is_quantized(wte_local):
+        # int8 rows dequantize AFTER the lookup (per-ROW scales: the
+        # embedding's output channel is the vocab row)
+        q, s = wte_local["q"], wte_local["s"]
+        vocab_local = q.shape[0]
+        start = jax.lax.axis_index(axis) * vocab_local
+        idx = tokens - start
+        valid = (idx >= 0) & (idx < vocab_local)
+        idx = jnp.clip(idx, 0, vocab_local - 1)
+        emb = (jnp.take(q, idx, axis=0).astype(s.dtype)
+               * jnp.take(s.reshape(-1), idx)[..., None])
+        emb = emb * valid[..., None].astype(emb.dtype)
+        return jax.lax.psum(emb, axis)
     vocab_local = wte_local.shape[0]
     start = jax.lax.axis_index(axis) * vocab_local
     idx = tokens - start
@@ -207,7 +230,14 @@ def vocab_parallel_embedding(tokens, wte_local, axis=MODEL_AXIS):
 def vocab_parallel_logits(h, wte_local):
     """Weight-tied LM head: h [..., hid] replicated; wte_local [vocab/mp, hid]
     → logits [..., vocab/mp] sharded on the vocab dim (feeds directly into
-    ``vocab_parallel_cross_entropy`` with no gather)."""
+    ``vocab_parallel_cross_entropy`` with no gather).  An int8-quantized
+    ``wte`` follows the matmul-dequant dispatch (per-row scales are the
+    logits' per-output-channel scales)."""
+    if is_quantized(wte_local):
+        if quant_matmul_plan() == "dequant":
+            return h @ dequantize(wte_local).astype(h.dtype).T
+        y = h @ wte_local["q"].astype(h.dtype).T
+        return y * wte_local["s"].reshape(-1).astype(y.dtype)
     return h @ wte_local.astype(h.dtype).T
 
 
@@ -317,6 +347,143 @@ def gelu(x):
     y = 0.5 * xf * (1.0 + jnp.tanh(
         0.7978845608028654 * (xf + 0.044715 * xf ** 3)))
     return y.astype(x.dtype)
+
+
+# --------------------------------------------------------------- serving
+# int8 weight-only quantization (deepspeed_tpu/inference/): weights are
+# stored as {"q": int8, "s": per-output-channel scale} subtrees, and the
+# matmul-dequant strategy rides a per-backend dispatch table like the
+# attention kernels above (docs/inference.md "Quantization").  Two impls:
+#   "dequant" — materialise W = q*s in the compute dtype, then matmul
+#               (the exactness anchor: one rounding per weight element)
+#   "scaled"  — contract x @ q first, scale the [..., out] activation
+#               (the serving default: per-output-channel scales commute
+#               with the contraction, so this is the same math with the
+#               scale applied once per OUTPUT element — it never
+#               materialises the dequantized [in, out] weight, which is
+#               the entire memory win of int8 at decode batch sizes)
+# The two differ by float rounding only; the contract is pinned in
+# tests/test_inference.py and documented in docs/inference.md.
+QUANT_MATMUL_IMPLS = ("auto", "dequant", "scaled")
+
+
+def quant_matmul_plan() -> str:
+    """Resolved matmul-dequant impl ("dequant" | "scaled") for the current
+    mode: env ``DSTPU_QUANT_MATMUL`` pins one; "auto" (default) picks
+    "scaled" — at serving shapes the activation side is orders of
+    magnitude smaller than the weight it would otherwise dequantize."""
+    mode = os.environ.get("DSTPU_QUANT_MATMUL", "auto")
+    if mode not in QUANT_MATMUL_IMPLS:
+        raise ValueError(
+            f"DSTPU_QUANT_MATMUL={mode!r} is not a valid impl: use 'auto', "
+            f"'dequant' or 'scaled'")
+    return "scaled" if mode == "auto" else mode
+
+
+def is_quantized(w) -> bool:
+    """True for an int8-quantized weight subtree ({"q", "s"})."""
+    return isinstance(w, dict) and set(w) == {"q", "s"}
+
+
+def dequantize(wq):
+    """Materialise the full-precision weight of a quantized subtree: the
+    scale's dtype IS the serving compute dtype (inference/quant.py)."""
+    return wq["q"].astype(wq["s"].dtype) * wq["s"]
+
+
+def matmul_dequant(x, wq):
+    """``x @ W`` for an int8 per-OUTPUT-channel quantized ``W`` (scale
+    keepdims-shaped ``[1, out]``), per the dispatch plan."""
+    if quant_matmul_plan() == "dequant":
+        return x @ dequantize(wq).astype(x.dtype)
+    y = x @ wq["q"].astype(x.dtype)
+    return y * wq["s"].reshape(-1).astype(y.dtype)
+
+
+def write_kv_cache(cache, new, idx):
+    """Functional per-slot row write: ``cache[b, idx[b]] = new[b]``.
+
+    cache: [B, cap, n, d]; new: [B, n, d]; idx: int32 [B].  Expressed as a
+    one-hot blend so per-slot positions (continuous batching: every slot
+    is at its own decode offset) stay a single vectorized XLA op — a
+    gather/scatter would serialize on TPU."""
+    cap = cache.shape[1]
+    oh = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+          == idx[:, None]).astype(cache.dtype)[..., None, None]
+    return cache * (1 - oh) + new.astype(cache.dtype)[:, None] * oh
+
+
+def cached_attention(q, k_cache, v_cache, pos, ring: bool = False):
+    """Single-query attention against a per-slot KV cache.
+
+    q: [B, n, d] (this step's query, already written to the cache at its
+    own index); caches: [B, cap, n, d]; pos: int32 [B] — the query's own
+    position, so cache entries ``<= pos`` attend.  ``ring=True`` admits
+    every entry once a slot has wrapped (the sliding-window layout).
+    Numerics mirror ``ops.pallas_attention.xla_attention`` (fp32 MXU
+    accumulation for the scores and softmax, probabilities cast to the
+    compute dtype before the value contraction) so incremental decode
+    stays within dtype tolerance of a full-context re-forward."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bnd,btnd->bnt", q, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    cap = k_cache.shape[1]
+    valid = jnp.arange(cap, dtype=jnp.int32)[None, :] <= pos[:, None]
+    if ring:
+        valid = valid | (pos[:, None] >= cap)
+    scores = jnp.where(valid[:, None, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnt,btnd->bnd", probs, v_cache.astype(q.dtype))
+
+
+def prefill_multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local,
+                                proj_b, *, n_heads_global, causal,
+                                attn_mask=None, axis=MODEL_AXIS):
+    """``multihead_attention`` that ALSO returns this layer's K/V — the
+    prefill half of the KV-cached serving path.  Same projection and
+    ``core_attention`` math as the training forward (the decode-path
+    exactness oracle depends on it); sequence parallelism is not a
+    serving layout, so the seq axis must be unsharded here."""
+    if axis_size_or_1(SEQ_AXIS) > 1:
+        raise ValueError(
+            "prefill_multihead_attention: KV-cached serving does not "
+            "compose with context parallelism (shard requests over "
+            "engine replicas instead)")
+    B, T, h = x.shape
+    d = h // n_heads_global
+    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)
+    n_local = qkv.shape[-1] // (3 * d)
+    qkv = qkv.reshape(B, T, n_local, 3, d)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    ctx = core_attention(q, k, v, causal=causal, attn_mask=attn_mask)
+    ctx = ctx.reshape(B, T, n_local * d)
+    return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis), k, v
+
+
+def decode_multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local,
+                               proj_b, k_cache, v_cache, pos, write_idx,
+                               *, n_heads_global, ring: bool = False,
+                               axis=MODEL_AXIS):
+    """One-token attention step against the KV cache.
+
+    x: [B, 1, h]; caches: [B, cap, n_local, d]; pos/write_idx: int32 [B]
+    (absolute position and cache row — they differ only in the ring
+    layout, where the row wraps).  Writes this step's K/V, attends the
+    query against the updated cache, and returns ``(out [B, 1, h],
+    k_cache', v_cache')``."""
+    B, _, h = x.shape
+    d = h // n_heads_global
+    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)  # [B,1,3h/mp]
+    n_local = qkv.shape[-1] // (3 * d)
+    qkv = qkv.reshape(B, n_local, 3, d)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    k_cache = write_kv_cache(k_cache, k, write_idx)
+    v_cache = write_kv_cache(v_cache, v, write_idx)
+    ctx = cached_attention(q, k_cache, v_cache, pos, ring=ring)
+    ctx = ctx.reshape(B, 1, n_local * d)
+    out = row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
+    return out, k_cache, v_cache
 
 
 def attention_plan(T, n, d, causal):

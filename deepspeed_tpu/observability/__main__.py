@@ -1,10 +1,11 @@
 """JSONL event-log validator CLI.
 
 ``python -m deepspeed_tpu.observability <events.jsonl> [...]`` — validates
-every line of each telemetry event log.  Streams may interleave the three
+every line of each telemetry event log.  Streams may interleave the four
 event schemas (``dstpu.telemetry.window`` v1/v2, ``dstpu.telemetry.fleet``
-v2, ``dstpu.telemetry.startup`` v2 — observability/schema.py); v1
-window-only logs from before the fleet layer still validate.  Exit codes:
+v2, ``dstpu.telemetry.startup`` v2, ``dstpu.telemetry.serve`` v1 —
+observability/schema.py, each on its own version track); v1 window-only
+logs from before the fleet layer still validate.  Exit codes:
 0 = every file valid and non-empty, 2 = any problem — invalid lines,
 unknown schemas, unreadable or EMPTY files (the CI observability smoke
 job's gate, pinned by tests/test_fleet.py).  Needs no jax — it is a
@@ -22,7 +23,8 @@ from deepspeed_tpu.observability import schema
 def _summary(path: str) -> str:
     counts = schema.count_by_schema(path)
     short = {schema.SCHEMA_ID: "window", schema.FLEET_SCHEMA_ID: "fleet",
-             schema.STARTUP_SCHEMA_ID: "startup"}
+             schema.STARTUP_SCHEMA_ID: "startup",
+             schema.SERVE_SCHEMA_ID: "serve"}
     parts = [f"{n} {short.get(sid, sid)}"
              for sid, n in sorted(counts.items(),
                                   key=lambda kv: -kv[1])]
@@ -33,9 +35,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability",
         description="Validate telemetry JSONL event logs (schemas: "
-                    "%s v1/v2, %s v2, %s v2)" % (
+                    "%s v1/v2, %s v2, %s v2, %s v1)" % (
                         schema.SCHEMA_ID, schema.FLEET_SCHEMA_ID,
-                        schema.STARTUP_SCHEMA_ID))
+                        schema.STARTUP_SCHEMA_ID, schema.SERVE_SCHEMA_ID))
     parser.add_argument("paths", nargs="+", help="JSONL event log(s)")
     args = parser.parse_args(argv)
 

@@ -7,7 +7,7 @@ guessing.  Validation is hand-rolled — no jsonschema dependency — and
 doubles as the documentation of record for every field
 (docs/observability.md mirrors these tables).
 
-Three event schemas share one stream (a rank-0 log interleaves them):
+Four event schemas share one stream (a rank-0 log interleaves them):
 
 * ``dstpu.telemetry.window``  — one line per drained metric window.
   v1 (PR 7) logs still validate; v2 adds the per-host fleet-report
@@ -19,6 +19,10 @@ Three event schemas share one stream (a rank-0 log interleaves them):
 * ``dstpu.telemetry.fleet``   — one line per cross-host aggregated window
   (v2, rank 0 only): per-host min/median/max timings, straggler index and
   flags, anomaly roll-up, counter sums, the full per-host report map.
+* ``dstpu.telemetry.serve``   — one line per serving window (v1, its own
+  version track): continuous-batching decode iterations, tokens
+  delivered, slot occupancy, and p50/p99 TTFT / inter-token latency
+  (deepspeed_tpu/inference/driver.py, docs/inference.md).
 
 Schema evolution contract: additive fields bump the version with
 validators accepting all :data:`ACCEPTED_VERSIONS` and unknown EXTRA
@@ -40,6 +44,15 @@ ACCEPTED_VERSIONS = (1, 2)
 #: fleet/startup schemas (introduced at v2 — no v1 ever existed)
 FLEET_SCHEMA_ID = "dstpu.telemetry.fleet"
 STARTUP_SCHEMA_ID = "dstpu.telemetry.startup"
+
+#: serving window events (PR 10, deepspeed_tpu/inference/driver.py):
+#: one line per window of continuous-batching decode iterations.  Own
+#: version track (v1) — the validator is version-aware per schema, so a
+#: future additive field bumps SERVE_ACCEPTED_VERSIONS without touching
+#: the training schemas.
+SERVE_SCHEMA_ID = "dstpu.telemetry.serve"
+SERVE_SCHEMA_VERSION = 1
+SERVE_ACCEPTED_VERSIONS = (1,)
 
 _NUM = numbers.Real
 
@@ -132,6 +145,29 @@ STARTUP_FIELDS = {
     "compile_cache_misses": (int, False),
 }
 
+#: serve event fields (schema ``dstpu.telemetry.serve`` v1) — the
+#: continuous-batching window record (docs/inference.md "Telemetry")
+SERVE_FIELDS = {
+    "schema": (str, True),
+    "version": (int, True),
+    "ts": (_NUM, True),
+    "window": (int, True),              # window ordinal (1-based)
+    "decode_iters": (int, True),        # scheduler iterations folded in
+    "tokens_out": (int, True),          # tokens delivered this window
+    "admitted": (int, True),            # requests admitted this window
+    "evicted": (int, True),             # cumulative completed requests
+    "active_slots_mean": (_NUM, True),  # mean occupied decode slots
+    "queue_depth": (int, True),         # waiting requests at window end
+    "slots": (int, True),               # total decode slots
+    "kv_cache_gb": (_NUM, False),       # preallocated cache size
+    "tokens_per_sec": (_NUM, False),    # this window's delivery rate
+    "ttft_p50_ms": (_NUM, False),       # over COMPLETED requests so far
+    "ttft_p99_ms": (_NUM, False),
+    "itl_p50_ms": (_NUM, False),        # inter-token latency
+    "itl_p99_ms": (_NUM, False),
+    "counters": (dict, True),           # resilience/compile-cache roll-up
+}
+
 _SCHEMAS = None
 
 
@@ -142,6 +178,7 @@ def _schemas():
             SCHEMA_ID: (FIELDS, ACCEPTED_VERSIONS),
             FLEET_SCHEMA_ID: (FLEET_FIELDS, (2,)),
             STARTUP_SCHEMA_ID: (STARTUP_FIELDS, (2,)),
+            SERVE_SCHEMA_ID: (SERVE_FIELDS, SERVE_ACCEPTED_VERSIONS),
         }
     return _SCHEMAS
 
@@ -228,6 +265,25 @@ def validate_startup_event(event: dict) -> Optional[str]:
     return _validate_fields(event, STARTUP_FIELDS, (2,))
 
 
+def validate_serve_event(event: dict) -> Optional[str]:
+    """Validate a SERVE window event (continuous-batching telemetry)."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != SERVE_SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{SERVE_SCHEMA_ID!r}")
+    msg = _validate_fields(event, SERVE_FIELDS, SERVE_ACCEPTED_VERSIONS)
+    if msg is not None:
+        return msg
+    if event["decode_iters"] <= 0:
+        return f"decode_iters must be > 0, got {event['decode_iters']}"
+    if event["slots"] < 1:
+        return f"slots must be >= 1, got {event['slots']}"
+    if event["tokens_out"] < 0:
+        return f"tokens_out must be >= 0, got {event['tokens_out']}"
+    return _validate_counters(event["counters"])
+
+
 def _validate_counters(counters: dict) -> Optional[str]:
     for k, v in counters.items():
         if not isinstance(k, str) or (v is not None
@@ -249,8 +305,11 @@ def validate_any(event: dict) -> Optional[str]:
         return validate_fleet_event(event)
     if sid == STARTUP_SCHEMA_ID:
         return validate_startup_event(event)
+    if sid == SERVE_SCHEMA_ID:
+        return validate_serve_event(event)
     return (f"unknown schema {sid!r}; expected one of "
-            f"[{SCHEMA_ID!r}, {FLEET_SCHEMA_ID!r}, {STARTUP_SCHEMA_ID!r}]")
+            f"[{SCHEMA_ID!r}, {FLEET_SCHEMA_ID!r}, {STARTUP_SCHEMA_ID!r}, "
+            f"{SERVE_SCHEMA_ID!r}]")
 
 
 def validate_jsonl(path: str) -> list:

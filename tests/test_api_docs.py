@@ -63,6 +63,24 @@ def test_model_entry_points_documented():
     assert not missing, f"model classes absent from docs/api.md: {missing}"
 
 
+def test_inference_engine_documented():
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    text = _api_text()
+    missing = [m for m in _public_methods(InferenceEngine)
+               if m not in text]
+    assert not missing, (
+        f"public InferenceEngine methods absent from docs/api.md: "
+        f"{missing} — document them (or underscore-prefix if internal)")
+
+
+def test_inference_exports_documented():
+    import deepspeed_tpu.inference as inf
+    text = _api_text()
+    missing = [n for n in inf.__all__ if n not in text]
+    assert not missing, (
+        f"inference exports absent from docs/api.md: {missing}")
+
+
 def test_initialize_kwargs_documented():
     import inspect
 

@@ -1,0 +1,489 @@
+"""Inference & serving engine (deepspeed_tpu/inference/, docs/inference.md).
+
+The load-bearing pins:
+
+* **Decode-path correctness oracle** — N-step incremental decode with the
+  KV cache is EXACT vs a full-context re-forward on the same prompt
+  (argmax-identical, logits within dtype tolerance), at mp=1 and mp=2.
+* **Batching invariance** — a slot's output stream is identical whether
+  it shares decode iterations with neighbours or runs alone (continuous
+  batching must be a scheduling optimization, never a numerics change).
+* **int8 exactness contract** — quantized serving within the documented
+  relative-logit tolerance of the unquantized engine; the "scaled" and
+  "dequant" matmul-dequant impls agree.
+* **Weights-only restore** — ``checkpoint.load_params_only`` never opens
+  a ``zero_pp_rank_*`` optimizer shard record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu import checkpoint
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import (ContinuousScheduler, InferenceEngine,
+                                     Request, StaticScheduler, kvcache,
+                                     run_serve, synthetic_requests)
+from deepspeed_tpu.models.gpt2 import GPT2
+
+TINY = dict(vocab_size=128, max_seq_len=64, num_layers=2, hidden_size=64,
+            num_heads=4)
+
+
+def tiny_model():
+    return GPT2.from_size("tiny", **TINY)
+
+
+def serve_config(**inf):
+    base = {"max_slots": 3, "max_tokens": 32, "prefill_bucket": 16,
+            "page_tokens": 32, "dtype": "float32"}
+    base.update(inf)
+    return {"train_micro_batch_size_per_gpu": 1, "inference": base,
+            "graph_lint": "error",
+            "analysis": {"mode": "error", "profile": "v4-8"}}
+
+
+@pytest.fixture(scope="module")
+def eng_fp32():
+    return InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+
+
+@pytest.fixture(scope="module")
+def eng_mp2():
+    cfg = serve_config()
+    cfg["model_parallel_size"] = 2
+    return InferenceEngine(tiny_model(), config=cfg, seed=0)
+
+
+def _oracle(eng, prompt, steps, atol):
+    """Incremental decode vs full-context re-forward, step by step."""
+    eng.reset()
+    logits = eng.prefill(0, prompt)
+    seq = list(prompt)
+    cur = int(np.argmax(logits))
+    for _ in range(steps):
+        seq.append(cur)
+        ref = eng.prefill(1, seq)            # full re-forward, other slot
+        feed = np.zeros(eng.num_slots, np.int32)
+        feed[0] = cur
+        act = np.zeros(eng.num_slots, bool)
+        act[0] = True
+        dec = eng.decode(feed, act)[0]
+        assert int(np.argmax(dec)) == int(np.argmax(ref)), (
+            "incremental decode argmax diverged from full re-forward")
+        np.testing.assert_allclose(dec, ref, atol=atol)
+        cur = int(np.argmax(dec))
+    eng.reset()
+
+
+def test_decode_oracle_exact_mp1(eng_fp32):
+    _oracle(eng_fp32, [1, 2, 3, 4, 5], steps=5, atol=1e-4)
+
+
+def test_decode_oracle_exact_mp2(eng_mp2, eng_fp32):
+    _oracle(eng_mp2, [7, 8, 9], steps=5, atol=1e-4)
+    # and mp=2 matches mp=1 on the same prompt (tensor parallelism is a
+    # layout, not a model change)
+    l1 = eng_fp32.prefill(0, [1, 2, 3, 4])
+    l2 = eng_mp2.prefill(0, [1, 2, 3, 4])
+    np.testing.assert_allclose(l1, l2, atol=1e-4)
+    eng_fp32.reset()
+    eng_mp2.reset()
+
+
+def test_decode_oracle_bf16_within_dtype_tolerance():
+    eng = InferenceEngine(tiny_model(),
+                          config=serve_config(dtype="bfloat16"), seed=3)
+    eng.reset()
+    prompt = [5, 6, 7, 8]
+    logits = eng.prefill(0, prompt)
+    cur = int(np.argmax(logits))
+    seq = list(prompt)
+    for _ in range(3):
+        seq.append(cur)
+        ref = eng.prefill(1, seq)
+        feed = np.zeros(eng.num_slots, np.int32)
+        feed[0] = cur
+        act = np.zeros(eng.num_slots, bool)
+        act[0] = True
+        dec = eng.decode(feed, act)[0]
+        # bf16: same math, different reduction orders — dtype tolerance
+        scale = np.max(np.abs(ref)) + 1e-9
+        assert np.max(np.abs(dec - ref)) / scale < 0.05
+        assert int(np.argmax(dec)) == int(np.argmax(ref))
+        cur = int(np.argmax(dec))
+
+
+# ------------------------------------------------------------ quantization
+
+def test_int8_within_documented_tolerance(eng_fp32):
+    engq = InferenceEngine(tiny_model(),
+                           config=serve_config(quantize="int8"), seed=0)
+    prompt = [1, 2, 3, 4, 5]
+    lq = engq.prefill(0, prompt)
+    lf = eng_fp32.prefill(0, prompt)
+    eng_fp32.reset()
+    # the exactness contract of docs/inference.md: relative logit error
+    # under 5% (measured ~0.6% at this shape)
+    rel = np.max(np.abs(lq - lf)) / (np.max(np.abs(lf)) + 1e-9)
+    assert rel < 0.05, rel
+    # int8 payloads actually live as int8 (the memory win is real)
+    q = engq.params["blocks"]["qkv_w"]
+    assert set(q) == {"q", "s"}
+    assert np.asarray(q["q"]).dtype == np.int8
+    assert engq.weight_bytes < eng_fp32.weight_bytes / 2
+
+    # dispatch table: "scaled" (default) vs "dequant" agree within float
+    # rounding; an invalid impl is rejected loudly
+    os.environ["DSTPU_QUANT_MATMUL"] = "dequant"
+    try:
+        ld = engq.prefill(0, prompt)
+    finally:
+        del os.environ["DSTPU_QUANT_MATMUL"]
+    np.testing.assert_allclose(lq, ld, atol=1e-4)
+    os.environ["DSTPU_QUANT_MATMUL"] = "fast"
+    try:
+        from deepspeed_tpu.models import layers as L
+        with pytest.raises(ValueError, match="DSTPU_QUANT_MATMUL"):
+            L.quant_matmul_plan()
+    finally:
+        del os.environ["DSTPU_QUANT_MATMUL"]
+
+
+def test_int8_generates_and_config_guard():
+    engq = InferenceEngine(tiny_model(),
+                           config=serve_config(quantize="int8"), seed=1)
+    outs = engq.generate([[1, 2, 3]], max_new_tokens=4)
+    assert len(outs[0]) == 4
+    with pytest.raises(DeepSpeedConfigError, match="quantize"):
+        InferenceEngine(tiny_model(), config=serve_config(quantize="int4"))
+
+
+# ------------------------------------------------- continuous batching
+
+def test_batching_invariance(eng_fp32):
+    """A request's stream is identical solo vs sharing slots — the KV
+    cache masks strictly per slot."""
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [4, 4]]
+    eng_fp32.reset()
+    together = eng_fp32.generate(prompts, max_new_tokens=6)
+    solo = []
+    for p in prompts:
+        eng_fp32.reset()
+        solo.append(eng_fp32.generate([p], max_new_tokens=6)[0])
+    assert together == solo
+    eng_fp32.reset()
+
+
+def test_scheduler_admission_eviction_bookkeeping(eng_fp32):
+    eng_fp32.reset()
+    sched = ContinuousScheduler(eng_fp32)
+    max_active = 0
+    reqs = [Request(rid=i, prompt=[i + 1, i + 2],
+                    max_new_tokens=3 + (i % 4)) for i in range(7)]
+    for r in reqs:
+        sched.submit(r)
+    while sched.queue or sched.active:
+        stats = sched.step()
+        max_active = max(max_active, stats["active"])
+        assert stats["active"] <= eng_fp32.num_slots
+    assert max_active == eng_fp32.num_slots       # slots actually fill
+    assert sched.admitted == 7 and sched.evicted == 7
+    assert len(sched.results) == 7
+    for r in sched.results:
+        req = reqs[r.rid]
+        assert len(r.tokens) == req.max_new_tokens
+        assert r.finish_reason == "length"
+        assert r.ttft_s is not None
+        assert len(r.itl_s) == len(r.tokens) - 1
+    eng_fp32.reset()
+
+
+def test_eos_eviction(eng_fp32):
+    """A sampler that emits EOS on the second token frees the slot early."""
+    eng_fp32.reset()
+    calls = {"n": 0}
+
+    def eos_on_second(logits_row):
+        calls["n"] += 1
+        return 42 if calls["n"] >= 2 else 7
+
+    sched = ContinuousScheduler(eng_fp32, sampler=eos_on_second)
+    sched.submit(Request(rid=0, prompt=[1, 2, 3], max_new_tokens=20,
+                         eos_id=42))
+    results = sched.run()
+    assert results[0].finish_reason == "eos"
+    assert results[0].tokens == [7, 42]
+    eng_fp32.reset()
+
+
+def test_static_matches_continuous_with_more_iters(eng_fp32):
+    reqs = synthetic_requests(6, vocab=TINY["vocab_size"], seed=5,
+                              prompt_min=2, prompt_max=8, new_min=2,
+                              new_max=9)
+    eng_fp32.reset()
+    cont = ContinuousScheduler(eng_fp32)
+    cont_results = cont.run(list(reqs))
+    eng_fp32.reset()
+    static = StaticScheduler(eng_fp32)
+    static_results = static.run(list(reqs))
+    by_rid = {r.rid: r.tokens for r in cont_results}
+    for r in static_results:
+        assert by_rid[r.rid] == r.tokens
+    # static decodes every batch to its longest member — it can never
+    # need FEWER iterations than continuous on the same trace
+    assert static.decode_iters >= cont.decode_iters
+    eng_fp32.reset()
+
+
+def test_prompt_guards(eng_fp32):
+    with pytest.raises(ValueError, match="prefill bucket"):
+        eng_fp32.prefill(0, list(range(17)))      # bucket is 16
+    with pytest.raises(ValueError, match="empty"):
+        eng_fp32.prefill(0, [])
+    with pytest.raises(ValueError, match="slot"):
+        eng_fp32.prefill(99, [1, 2])
+
+
+def test_request_budget_rejected_at_submit(eng_fp32):
+    """Over-budget requests fail at submit(), not mid-drain: past the
+    paged capacity (or max_seq_len) decode would silently clamp the
+    write row / position embedding and break the exactness contract."""
+    assert eng_fp32.max_total_tokens() == 32      # min(capacity, max_seq)
+    sched = ContinuousScheduler(eng_fp32)
+    with pytest.raises(ValueError, match="token budget"):
+        sched.submit(Request(rid=0, prompt=[1] * 10, max_new_tokens=30))
+    with pytest.raises(ValueError, match="prefill bucket"):
+        sched.submit(Request(rid=1, prompt=[1] * 17, max_new_tokens=1))
+    assert sched.pending == 0                     # nothing half-admitted
+    # the static baseline enforces the same contract up front
+    with pytest.raises(ValueError, match="token budget"):
+        StaticScheduler(eng_fp32).run(
+            [Request(rid=2, prompt=[1] * 10, max_new_tokens=30)])
+    # within budget still admits
+    sched.submit(Request(rid=3, prompt=[1, 2], max_new_tokens=4))
+    assert sched.pending == 1
+    sched.queue.clear()
+
+
+# ------------------------------------------------------------ KV cache
+
+def test_ring_layout_wraps_and_paged_matches_below_capacity():
+    cfgp = serve_config(max_tokens=8, prefill_bucket=8, page_tokens=8,
+                        max_slots=2)
+    cfgr = serve_config(max_tokens=8, prefill_bucket=8, page_tokens=8,
+                        max_slots=2, kv_layout="ring")
+    ep = InferenceEngine(tiny_model(), config=cfgp, seed=2)
+    er = InferenceEngine(tiny_model(), config=cfgr, seed=2)
+    assert er.cache_spec.ring and not ep.cache_spec.ring
+    # below capacity the layouts are the same math
+    outs_p = ep.generate([[1, 2, 3]], max_new_tokens=4)
+    outs_r = er.generate([[1, 2, 3]], max_new_tokens=4)
+    assert outs_p == outs_r
+    # beyond capacity the ring wraps instead of clamping: positions keep
+    # advancing and generation continues (windowed attention, documented
+    # approximation)
+    er.reset()
+    out = er.generate([[1, 2, 3]], max_new_tokens=12)[0]
+    assert len(out) == 12
+
+
+def test_kvcache_arithmetic():
+    assert kvcache.round_to_pages(100, 64) == 128
+    spec = kvcache.KVCacheSpec(layers=2, slots=4, capacity=128,
+                               kv_heads_local=4, head_dim=16,
+                               dtype=np.float32)
+    # 2 (k+v) * L * slots * cap * heads * dim * 4B
+    assert kvcache.cache_bytes(spec) == 2 * 2 * 4 * 128 * 4 * 16 * 4
+    n = kvcache.plan_slots(2, 4, 16, 128, np.float32,
+                           hbm_bytes=10 * (1 << 20), weight_bytes=1 << 20,
+                           headroom_frac=0.1)
+    per_slot = 2 * 2 * 128 * 4 * 16 * 4
+    assert n == (int(10 * (1 << 20) * 0.9) - (1 << 20)) // per_slot
+    assert kvcache.plan_slots(2, 4, 16, 128, np.float32,
+                              hbm_bytes=1 << 40, weight_bytes=0) == 256
+    with pytest.raises(ValueError, match="does not fit"):
+        kvcache.plan_slots(2, 4, 16, 128, np.float32,
+                           hbm_bytes=1 << 20, weight_bytes=1 << 20)
+
+
+def test_auto_slots_need_profile_and_size_against_it():
+    cfg = serve_config(max_slots=0)
+    cfg["analysis"] = {"mode": "off"}     # no profile configured
+    with pytest.raises(ValueError, match="profile"):
+        InferenceEngine(tiny_model(), config=cfg, seed=0)
+    cfg2 = serve_config(max_slots=0)      # v4-8 profile: plenty of slots
+    cfg2["analysis"]["mode"] = "off"      # auto-sized 256-slot cache is
+    # bigger than the tiny gate fixtures need — sizing is what's under
+    # test here, not the budget gate
+    eng = InferenceEngine(tiny_model(), config=cfg2, seed=0)
+    assert eng.num_slots == 256           # the auto cap, with this much HBM
+
+
+# -------------------------------------------- lint + capacity plan gates
+
+def test_serve_programs_lint_clean_and_planned(eng_fp32):
+    rep = eng_fp32.run_graph_lint()
+    assert not rep.errors, rep.format()
+    plan = eng_fp32.plan_capacity()
+    assert sorted(p.subject for p in plan.programs) == ["decode", "prefill"]
+    assert plan.persistent["kv_cache_bytes"] == kvcache.cache_bytes(
+        eng_fp32.cache_spec)
+    assert plan.peak_bytes > 0
+    assert "kv cache" in plan.format_table()
+
+
+def test_memplan_gate_fails_closed_on_tiny_budget():
+    from deepspeed_tpu.analysis import MemoryPlanError
+    cfg = serve_config()
+    cfg["analysis"] = {"mode": "error", "memory_budget_gb": 1e-6}
+    with pytest.raises(MemoryPlanError):
+        InferenceEngine(tiny_model(), config=cfg, seed=0)
+
+
+def test_inference_config_validation():
+    with pytest.raises(DeepSpeedConfigError, match="unknown inference"):
+        InferenceEngine(tiny_model(),
+                        config={"inference": {"slots": 4}})
+    with pytest.raises(DeepSpeedConfigError, match="kv_layout"):
+        InferenceEngine(tiny_model(),
+                        config=serve_config(kv_layout="circular"))
+    with pytest.raises(DeepSpeedConfigError, match="prefill_bucket"):
+        InferenceEngine(tiny_model(),
+                        config=serve_config(prefill_bucket=999))
+    with pytest.raises(DeepSpeedConfigError, match="dtype"):
+        InferenceEngine(tiny_model(), config=serve_config(dtype="int7"))
+
+
+# ------------------------------------------------- weights-only restore
+
+def _train_and_save(tmp_path, stage, fmt_kw=None):
+    model = tiny_model()
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "bf16": {"enabled": True}}
+    if stage:
+        cfg["zero_optimization"] = {"stage": stage}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    toks = np.random.default_rng(0).integers(
+        0, TINY["vocab_size"], (8, 16)).astype(np.int32)
+    engine.train_batch((toks, toks.copy()))
+    engine.save_checkpoint(str(tmp_path), **(fmt_kw or {}))
+    return engine
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_load_params_only_skips_zero_shards(tmp_path, stage):
+    engine = _train_and_save(tmp_path, stage)
+    opened = []
+    orig = checkpoint._load_obj
+
+    def spy(path):
+        opened.append(os.path.basename(path))
+        return orig(path)
+
+    checkpoint._load_obj = spy
+    try:
+        tag, tree = checkpoint.load_params_only(str(tmp_path))
+    finally:
+        checkpoint._load_obj = orig
+    assert tag == "global_step1"
+    # the regression pin: optimizer flat-partition shard records are
+    # NEVER opened by the weights-only path
+    assert not any(p.startswith("zero_pp_rank") for p in opened), opened
+    for got, want in zip(jax.tree_util.tree_leaves(tree),
+                         jax.tree_util.tree_leaves(engine.params)):
+        np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                      np.asarray(want, np.float32))
+
+
+def test_load_params_only_dtype_cast_and_parallel_parity(tmp_path):
+    engine = _train_and_save(tmp_path, 1)
+    _, t32 = checkpoint.load_params_only(str(tmp_path), dtype=np.float32)
+    _, tbf = checkpoint.load_params_only(str(tmp_path), dtype="bfloat16")
+    _, tser = checkpoint.load_params_only(str(tmp_path), threads=1)
+    for a in jax.tree_util.tree_leaves(t32):
+        assert a.dtype == np.float32
+    for a in jax.tree_util.tree_leaves(tbf):
+        assert str(a.dtype) == "bfloat16"
+    # serial fallback executes the identical read plan — bitwise
+    for a, b in zip(jax.tree_util.tree_leaves(tser),
+                    jax.tree_util.tree_leaves(
+                        checkpoint.load_params_only(str(tmp_path))[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    del engine
+
+
+def test_serve_from_checkpoint_cold_start_numbers(tmp_path):
+    """Checkpoint → tokens, with the cold-start facts recorded: the serve
+    startup event carries restore_seconds + compile-cache counters
+    exactly like the PR 9 training startup event."""
+    _train_and_save(tmp_path, 1)
+    eng = InferenceEngine(tiny_model(), config=serve_config(),
+                          checkpoint_dir=str(tmp_path))
+    assert eng.loaded_tag == "global_step1"
+    assert eng.restore_seconds is not None and eng.restore_seconds > 0
+    outs = eng.generate([[1, 2, 3]], max_new_tokens=3)
+    assert len(outs[0]) == 3
+    ev = eng.startup_event()
+    from deepspeed_tpu.observability import schema
+    assert schema.validate_any(ev) is None
+    assert ev["restore_seconds"] is not None
+    assert ev["time_to_first_step_s"] is not None
+    assert ev["compile_cache_hits"] is not None
+
+
+# ----------------------------------------------------- serve telemetry
+
+def test_serve_jsonl_validator_clean(tmp_path, eng_fp32):
+    eng_fp32.reset()
+    path = str(tmp_path / "serve.jsonl")
+    out = run_serve(eng_fp32,
+                    synthetic_requests(5, vocab=TINY["vocab_size"],
+                                       seed=2, prompt_min=2, prompt_max=8,
+                                       new_min=2, new_max=6),
+                    jsonl_path=path, window_iters=3)
+    assert out["summary"]["tokens_out"] > 0
+    assert out["summary"]["ttft_p99_ms"] is not None
+    from deepspeed_tpu.observability import schema
+    assert schema.validate_jsonl(path) == []
+    events = [json.loads(l) for l in open(path)]
+    serve = [e for e in events if e["schema"] == schema.SERVE_SCHEMA_ID]
+    start = [e for e in events if e["schema"] == schema.STARTUP_SCHEMA_ID]
+    assert serve and start
+    assert serve[-1]["itl_p99_ms"] is not None
+    # the validator CLI accepts the mixed serve/startup stream
+    rc = subprocess.call([sys.executable, "-m",
+                          "deepspeed_tpu.observability", path])
+    assert rc == 0
+    eng_fp32.reset()
+
+
+def test_serve_event_schema_rejects_bad_events():
+    from deepspeed_tpu.observability import schema
+    good = {"schema": schema.SERVE_SCHEMA_ID, "version": 1, "ts": 1.0,
+            "window": 1, "decode_iters": 4, "tokens_out": 9,
+            "admitted": 2, "evicted": 1, "active_slots_mean": 1.5,
+            "queue_depth": 0, "slots": 4, "kv_cache_gb": 0.1,
+            "tokens_per_sec": 10.0, "ttft_p50_ms": 1.0,
+            "ttft_p99_ms": 2.0, "itl_p50_ms": 0.5, "itl_p99_ms": 0.9,
+            "counters": {}}
+    assert schema.validate_any(good) is None
+    bad_version = dict(good, version=9)
+    assert "version" in schema.validate_any(bad_version)
+    missing = dict(good)
+    del missing["decode_iters"]
+    assert "decode_iters" in schema.validate_any(missing)
+    zero_iters = dict(good, decode_iters=0)
+    assert "decode_iters" in schema.validate_any(zero_iters)
+    assert "unknown schema" in schema.validate_any(
+        {"schema": "dstpu.telemetry.nonsense", "version": 1})
