@@ -1473,9 +1473,20 @@ def run_resume_bench(tmpdir=None):
             "computing garbage (known on jax 0.4.x XLA-CPU with donated "
             "buffers).  Rerun with DSTPU_NO_DONATE=1 to measure on this "
             "rig; the artifact records the switch")
-    rows["donation"] = ("off (DSTPU_NO_DONATE=1)"
-                        if os.environ.get("DSTPU_NO_DONATE") == "1"
-                        else "on")
+    if os.environ.get("DSTPU_NO_DONATE") == "1":
+        rows["donation"] = "off (DSTPU_NO_DONATE=1)"
+    else:
+        # the engine auto-skips donation when the persistent cache is
+        # enabled on a quirk-listed backend (the incident this leg's
+        # NaN guard caught — docs/resilience.md); record the EFFECTIVE
+        # donation so the measurement conditions stay explicit
+        from deepspeed_tpu.analysis import profiles as _prof
+        _p = _prof.default_profile()
+        rows["donation"] = (
+            "off (auto: persistent_cache_donation_unsafe)"
+            if (_p is not None and _p.persistent_cache_donation_unsafe
+                and os.environ.get("DSTPU_FORCE_DONATE") != "1")
+            else "on")
 
     rows["time_to_first_step_cold_s"] = round(
         rows["restore_serial_s"] + rows["compile_cold_s"], 3)
@@ -1616,6 +1627,129 @@ def _bench_serve(jsonl_dir=None):
     return 0
 
 
+def run_dispatch_bench():
+    """Dispatch-path microbench (BENCH_DISPATCH=1) — the measurement side
+    of the dispatch-cost pass (analysis/dispatchplan.py), modeled on
+    SNIPPETS [3]'s launch/fence/transfer microbenchmarks: empty-program
+    launch overhead (base + per-argument-leaf), per-step fence cost (the
+    host's device round trip), and host→device transfer latency +
+    bandwidth.  Emits measured columns NEXT TO the active BackendProfile's
+    predicted constants so each rig calibrates the profile — the ruler
+    ROADMAP item 4's multi-step driver will be judged against.
+
+    Knobs: BENCH_DISPATCH_REPEATS (median-of, default 5),
+    BENCH_DISPATCH_CALLS (launches per leg, default 200)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.analysis import profiles as prof_mod
+
+    repeats = int(os.environ.get("BENCH_DISPATCH_REPEATS", "5"))
+    calls = int(os.environ.get("BENCH_DISPATCH_CALLS", "200"))
+    prof = prof_mod.default_profile()
+
+    def med(fn):
+        return statistics.median(fn() for _ in range(repeats))
+
+    # ---- empty-program launch: dispatch-only time of a trivial jitted
+    # program (async queuing returns before execution), then the same
+    # with a 64-leaf argument tree to split out per-leaf marshalling
+    x = jnp.zeros((8,), jnp.float32)
+    f1 = jax.jit(lambda v: v + 1.0)
+    f1(x).block_until_ready()
+
+    def leg_dispatch():
+        t0 = time.perf_counter()
+        y = None
+        for _ in range(calls):
+            y = f1(x)
+        t1 = time.perf_counter()
+        y.block_until_ready()
+        return (t1 - t0) / calls * 1e6
+
+    dispatch_us = med(leg_dispatch)
+
+    NLEAF = 64
+    tree = {f"l{i}": jnp.zeros((8,), jnp.float32) for i in range(NLEAF)}
+    ftree = jax.jit(lambda t: jax.tree_util.tree_map(lambda v: v + 1.0, t))
+    jax.block_until_ready(ftree(tree))
+
+    def leg_tree():
+        t0 = time.perf_counter()
+        y = None
+        for _ in range(calls):
+            y = ftree(tree)
+        t1 = time.perf_counter()
+        jax.block_until_ready(y)
+        return (t1 - t0) / calls * 1e6
+
+    tree_us = med(leg_tree)
+    leaf_us = max(0.0, (tree_us - dispatch_us) / NLEAF)
+
+    # ---- per-step fence cost: dispatch + block on the result (one
+    # device round trip) minus the dispatch-only time
+    def leg_fence():
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            f1(x).block_until_ready()
+        t1 = time.perf_counter()
+        return (t1 - t0) / calls * 1e6
+
+    fence_us = max(0.0, med(leg_fence) - dispatch_us)
+
+    # ---- host→device transfer: tiny buffer = latency, big buffer =
+    # bandwidth (the batch-feeding cost class)
+    small = np.zeros((256,), np.float32)
+    big = np.zeros((16 << 20,), np.float32)        # 64 MiB
+    jax.device_put(big).block_until_ready()
+
+    def leg_small():
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            jax.device_put(small).block_until_ready()
+        return (time.perf_counter() - t0) / calls * 1e6
+
+    def leg_big():
+        n = max(1, calls // 50)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jax.device_put(big).block_until_ready()
+        return (time.perf_counter() - t0) / n
+
+    h2d_latency_us = med(leg_small)
+    big_s = med(leg_big)
+    h2d_gibps = big.nbytes / big_s / (1 << 30)
+
+    _emit({
+        "metric": "dispatch_microbench",
+        "unit": "us (median of repeats; predicted = BackendProfile "
+                "constants)",
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "hardware_true": jax.default_backend() == "tpu",
+        "calls_per_leg": calls, "repeats": repeats,
+        "profile": prof.name if prof else None,
+        "dispatch_us_measured": round(dispatch_us, 3),
+        "dispatch_us_predicted": prof.dispatch_us if prof else None,
+        "dispatch_leaf_us_measured": round(leaf_us, 4),
+        "dispatch_leaf_us_predicted": (prof.dispatch_leaf_us if prof
+                                       else None),
+        "fence_us_measured": round(fence_us, 3),
+        "fence_us_predicted": prof.fence_us if prof else None,
+        "h2d_latency_us_measured": round(h2d_latency_us, 3),
+        "h2d_gibps_measured": round(h2d_gibps, 3),
+        "h2d_gibps_predicted": prof.h2d_gibps if prof else None,
+        "callback_us_predicted": prof.callback_us if prof else None,
+        "note": ("the dispatch-cost pass prices the static host timeline "
+                 "with the predicted columns; measured columns are this "
+                 "rig's truth — recalibrate the profile when they drift. "
+                 "Re-measure: BENCH_DISPATCH=1 "
+                 "BENCH_OUT=bench_dispatch.json python bench.py")})
+    return 0
+
+
 def main():
     # A wedged device tunnel makes the first jax.devices() hang FOREVER
     # (observed failure mode: the axon relay listener disappears and every
@@ -1671,6 +1805,8 @@ def main():
         return run_overlap_bench()
     if os.environ.get("BENCH_OBS", "0") == "1":
         return run_obs_bench()
+    if os.environ.get("BENCH_DISPATCH", "0") == "1":
+        return run_dispatch_bench()
     if os.environ.get("BENCH_DATA", "0") == "1":
         return run_data_bench()
     if os.environ.get("BENCH_ATTN_SWEEP", "0") == "1":

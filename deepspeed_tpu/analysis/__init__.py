@@ -31,14 +31,23 @@ import jax
 
 from deepspeed_tpu.analysis import graph  # noqa: F401  (re-export for users)
 from deepspeed_tpu.analysis import commplan  # noqa: F401
+from deepspeed_tpu.analysis import dispatchplan  # noqa: F401
 from deepspeed_tpu.analysis import memplan  # noqa: F401
 from deepspeed_tpu.analysis import passes
 from deepspeed_tpu.analysis import profiles  # noqa: F401
+from deepspeed_tpu.analysis import stability  # noqa: F401
+from deepspeed_tpu.analysis.dispatchplan import (DispatchPlan,
+                                                 plan_engine_dispatch,
+                                                 plan_serve_dispatch)
 from deepspeed_tpu.analysis.memplan import (CapacityPlan, ProgramPlan,
                                             analyze_program, plan_engine)
 from deepspeed_tpu.analysis.report import (ERROR, INFO, WARNING, Finding,
                                            GraphLintError, MemoryPlanError,
                                            Report, ShardSpecError)
+from deepspeed_tpu.analysis.stability import (ExecutablePrediction,
+                                              ProgramSignature,
+                                              predict_executables,
+                                              signature_of)
 
 logger = logging.getLogger(__name__)
 
@@ -52,7 +61,10 @@ __all__ = [
     "check_shard_specs",
     "validate_specs_or_raise", "dispatch_report",
     "CapacityPlan", "ProgramPlan", "analyze_program", "plan_engine",
-    "commplan", "memplan", "profiles",
+    "DispatchPlan", "plan_engine_dispatch", "plan_serve_dispatch",
+    "ExecutablePrediction", "ProgramSignature", "predict_executables",
+    "signature_of",
+    "commplan", "dispatchplan", "memplan", "profiles", "stability",
 ]
 
 

@@ -182,12 +182,15 @@ def _build_model(family: str, seq_len: int, config_path: str = ""):
 
 
 def _analyze_serve_config(path: str, cfg: dict, an_cfg, suppress,
-                          plan: bool = False, profile: str = None):
+                          plan: bool = False, profile: str = None,
+                          dispatch: bool = False):
     """Serve-config analysis: build a tiny GPT-2 InferenceEngine on the
     config (gating sections stripped — the CLI dispatches itself) and
     lint/plan its PREFILL + DECODE programs.  The serving analog of the
     train-step gate — ``--plan`` adds the capacity table with the
-    persistent KV-cache line."""
+    persistent KV-cache line, ``--dispatch`` the compile-stability pass
+    (the exactly-two-executables invariant across prompt lengths) and
+    the priced per-iteration host timeline."""
     from deepspeed_tpu.inference import InferenceEngine
     from deepspeed_tpu.models.gpt2 import GPT2
 
@@ -196,27 +199,35 @@ def _analyze_serve_config(path: str, cfg: dict, an_cfg, suppress,
     if an_cfg and an_cfg.get("profile") and "analysis" not in cfg:
         cfg["analysis"] = {"profile": an_cfg["profile"]}
     model = GPT2.from_size("tiny")
+    dplans = None
     try:
         engine = InferenceEngine(model, config=cfg)
         rep = engine.run_graph_lint()
         cap = None
+        from deepspeed_tpu.analysis import profiles as prof_mod
+        prof = (prof_mod.resolve(profile) if profile
+                else prof_mod.default_profile())
         if plan:
-            from deepspeed_tpu.analysis import profiles as prof_mod
-            prof = (prof_mod.resolve(profile) if profile
-                    else prof_mod.default_profile())
             cap = engine.plan_capacity(profile=prof)
             rep.extend(cap.to_report(subject="serve"))
+        if dispatch:
+            rep.extend(engine.run_stability())
+            dplans = engine.plan_dispatch(profile=prof)
+            for p in dplans.values():
+                rep.extend(p.to_report())
     finally:
         from deepspeed_tpu.utils import compile_cache
         if compile_cache.enabled_dir() is not None:
             compile_cache.disable()
     rep.subject = f"{path} (model=serve)"
-    return rep.filtered(suppress), cap
+    return rep.filtered(suppress), cap, dplans
 
 
 def _analyze_config(path: str, family: str, seq_len: int, suppress,
-                    plan: bool = False, profile: str = None):
-    """(filtered lint Report, CapacityPlan | None) for one config."""
+                    plan: bool = False, profile: str = None,
+                    dispatch: bool = False):
+    """(filtered lint Report, CapacityPlan | None, dispatch plans | None)
+    for one config."""
     import jax
 
     import deepspeed_tpu
@@ -231,9 +242,11 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress,
     family = _infer_family(path, family)
     if family == "serve":
         return _analyze_serve_config(path, cfg, an_cfg, suppress,
-                                     plan=plan, profile=profile)
+                                     plan=plan, profile=profile,
+                                     dispatch=dispatch)
     model, make_batch = _build_model(family, seq_len, config_path=path)
     cap = None
+    dplans = None
     try:
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, config=cfg,
@@ -241,17 +254,26 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress,
         batch = make_batch(engine.train_micro_batch_size_per_gpu()
                            * engine.dp_world_size)
         rep = analysis.analyze_engine(engine, batch, train=True)
-        if plan:
-            from deepspeed_tpu.analysis import profiles as prof_mod
-            prof = (prof_mod.resolve(profile) if profile
-                    else prof_mod.default_profile())
+        from deepspeed_tpu.analysis import profiles as prof_mod
+        prof = (prof_mod.resolve(profile) if profile
+                else prof_mod.default_profile())
+        if plan or dispatch:
             # the fused train_batch program needs the full effective batch
             full = make_batch(engine.train_micro_batch_size_per_gpu()
                               * engine.dp_world_size
                               * engine.gradient_accumulation_steps())
+        if plan:
             cap = engine.plan_capacity(full, train=True, fused=True,
                                        profile=prof)
             rep.extend(cap.to_report(subject="train_batch"))
+        if dispatch:
+            # compile-stability + per-step host-cost passes over the
+            # production (fused) program family — stability.* errors
+            # (the PR 5/PR 10 classes) gate exactly like lint errors
+            rep.extend(engine.run_stability(full, fused=True))
+            dplans = {"train_batch": engine.plan_dispatch(
+                full, fused=True, profile=prof)}
+            rep.extend(dplans["train_batch"].to_report())
     finally:
         # engine build enables any configured persistent compile cache
         # PROCESS-WIDE (and exports the env fallback for relaunches) —
@@ -261,7 +283,7 @@ def _analyze_config(path: str, family: str, seq_len: int, suppress,
         if compile_cache.enabled_dir() is not None:
             compile_cache.disable()
     rep.subject = f"{path} (model={family})"
-    return rep.filtered(suppress), cap
+    return rep.filtered(suppress), cap, dplans
 
 
 def main(argv=None) -> int:
@@ -295,6 +317,12 @@ def main(argv=None) -> int:
                     help="run the capacity planner: predicted per-device "
                          "peak HBM + bytes on wire, gated against the "
                          "--profile budget (docs/analysis.md)")
+    ap.add_argument("--dispatch", action="store_true",
+                    help="run the compile-stability + dispatch-cost "
+                         "passes: executable-key hazards (the PR 5/PR 10 "
+                         "classes) as stability.* findings and the priced "
+                         "per-step host timeline (docs/analysis.md "
+                         "\"Dispatch & compile-stability\")")
     ap.add_argument("--profile", default=None,
                     help="backend profile for --plan (v4-8, v5e-8, v5p-8, "
                          "cpu-8; default: the running backend's profile)")
@@ -310,9 +338,10 @@ def main(argv=None) -> int:
     failed = []
     for path in args.configs:
         try:
-            rep, cap = _analyze_config(path, args.model, args.seq_len,
-                                       args.suppress, plan=args.plan,
-                                       profile=args.profile)
+            rep, cap, dplans = _analyze_config(
+                path, args.model, args.seq_len, args.suppress,
+                plan=args.plan, profile=args.profile,
+                dispatch=args.dispatch)
         except Exception as e:
             # keep analyzing the remaining configs so one broken config
             # does not hide whether the others are clean
@@ -334,6 +363,8 @@ def main(argv=None) -> int:
                 "errors": len(rep.errors),
                 "warnings": len(rep.warnings),
                 "plan": cap.to_json() if cap is not None else None,
+                "dispatch": ({k: p.to_json() for k, p in dplans.items()}
+                             if dplans is not None else None),
             }
             print(json.dumps(doc, sort_keys=True))
         else:
@@ -348,6 +379,10 @@ def main(argv=None) -> int:
             if cap is not None:
                 print("-- capacity plan --")
                 print(cap.format_table())
+            if dplans is not None:
+                for p in dplans.values():
+                    print("-- dispatch plan --")
+                    print(p.format_table())
             print()
         total_errors += len(rep.errors)
 

@@ -1,0 +1,473 @@
+"""Dispatch-cost pass — the static host timeline of one optimizer step.
+
+WALLCLOCK §7 pins ~35 ms/step of host-boundary work at gas=8 — program
+dispatches, deliberate fences, host↔device staging — a FIXED cost
+gradient accumulation cannot amortize and the known enemy of ROADMAP
+item 4's multi-step driver.  Until this pass the host boundary was only
+observable by running (the fences.py counter, the dispatch
+microbenches); here it becomes a static prediction: walk the engine's
+configuration (program shape, gas, spool window, skip contract, report
+cadence) and emit the per-step host timeline, priced in milliseconds by
+the :class:`~.profiles.BackendProfile` dispatch-overhead constants.
+
+Event classes:
+
+* **dispatch**  — one compiled-program launch (runtime call + argument
+  marshalling; cost scales with the argument leaf count);
+* **fence**     — a deliberate host wait on device data.  Every fence the
+  engine takes on purpose routes through ``observability/fences.py``, so
+  the prediction here is CHECKABLE: :class:`FenceModel` reproduces the
+  pinned counter exactly over an N-step run
+  (tests/test_dispatch_stability.py — prediction drift is a test
+  failure);
+* **transfer**  — host→device staging (batch feeding, hyper staging);
+* **callback**  — an in-graph host crossing (the telemetry spool drain —
+  once per report window, never per step).
+
+Findings ride the PR 2 report tree under ``dispatch.*``:
+
+``dispatch.report``            (info)    the priced timeline roll-up.
+``dispatch.fence-per-step``    (warning) a deliberate fence on EVERY
+    boundary at steady state — the spool exists to remove these
+    (``observability.report_window``); the fp16/nan-sentinel overflow
+    read with an LR scheduler is the documented exception.
+``dispatch.callback-per-step`` (warning) ``report_window: 1`` turns the
+    once-per-window drain into a per-step host crossing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+
+from deepspeed_tpu.analysis import profiles as prof_mod
+from deepspeed_tpu.analysis import report as R
+
+
+@dataclasses.dataclass
+class DispatchEvent:
+    """One host-boundary event class on the per-step timeline."""
+
+    kind: str                   # dispatch | fence | transfer | callback
+    label: str
+    per_step: float             # occurrences per optimizer step (may be
+                                # fractional: per-window events amortize)
+    n_leaves: int = 0           # argument leaves (dispatch marshalling)
+    bytes_per: int = 0          # payload bytes (transfers)
+    note: str = ""
+    #: False = a data dependency the design cannot remove (the serving
+    #: sampler's logits read): priced and counted, but never warned —
+    #: warning noise on unremovable fences would desensitize readers to
+    #: the genuinely fixable ones
+    removable: bool = True
+
+    def cost_ms(self, profile: Optional[prof_mod.BackendProfile]
+                ) -> Optional[float]:
+        """Predicted host ms per optimizer step for this event class."""
+        if profile is None:
+            return None
+        if self.kind == "dispatch":
+            each = (profile.dispatch_us
+                    + self.n_leaves * profile.dispatch_leaf_us) / 1e3
+        elif self.kind == "fence":
+            # round-trip latency + the payload the host actually reads
+            # back (the serving logits read moves 4*vocab*slots bytes per
+            # iteration — at real vocab sizes the copy, not the sync,
+            # dominates)
+            each = (profile.fence_us / 1e3
+                    + self.bytes_per / (profile.h2d_gibps * (1 << 30))
+                    * 1e3)
+        elif self.kind == "callback":
+            each = profile.callback_us / 1e3
+        else:                   # transfer: staging call + wire bytes
+            each = (profile.dispatch_us / 1e3
+                    + self.bytes_per / (profile.h2d_gibps * (1 << 30))
+                    * 1e3)
+        return self.per_step * each
+
+
+@dataclasses.dataclass
+class FenceModel:
+    """Exact deliberate-fence arithmetic for an N-step run — the static
+    twin of the ``observability.fences.FENCE_COUNT`` counter.
+
+    ``per_boundary`` fences fire on every optimizer boundary (the
+    fp16/nan-sentinel overflow read, the split-API TensorBoard loss
+    read, wall-clock-breakdown timer syncs).  The throughput reporter
+    additionally fences on report boundaries (``ThroughputTimer.stop``:
+    ``local_step % steps_per_output == 0`` once past ``start_step``) —
+    but only when the spool is off (with the spool on the engine passes
+    ``sync_on=None`` and goodput rides the drain timestamps) AND
+    something drives the timer's ``start()`` — the engine dataloader
+    does, a custom loop feeding ``train_batch`` directly does not.
+    ``flush_fences`` counts the synchronous spool flush the engine takes
+    at run end / preemption drain."""
+
+    per_boundary: int = 0
+    tput_report: bool = False
+    steps_per_output: int = 0
+    start_step: int = 2
+    flush_fences: int = 0       # per flush_telemetry() call, not per step
+
+    def count(self, n_steps: int, prior_boundaries: int = 0,
+              flushes: int = 0) -> int:
+        """Predicted fence-counter delta over ``n_steps`` boundaries
+        starting after ``prior_boundaries`` completed ones."""
+        total = n_steps * self.per_boundary
+        if self.tput_report and self.steps_per_output > 0:
+            for b in range(prior_boundaries + 1,
+                           prior_boundaries + n_steps + 1):
+                if b > self.start_step and \
+                        b % self.steps_per_output == 0:
+                    total += 1
+        return total + flushes * self.flush_fences
+
+    def per_step_steady(self) -> float:
+        """Average fences per boundary at steady state (report cadence
+        amortized)."""
+        rate = float(self.per_boundary)
+        if self.tput_report and self.steps_per_output > 0:
+            rate += 1.0 / self.steps_per_output
+        return rate
+
+
+@dataclasses.dataclass
+class DispatchPlan:
+    """The static host timeline of one optimizer step (or one serving
+    iteration), priced against a backend profile."""
+
+    subject: str
+    events: List[DispatchEvent]
+    fence_model: FenceModel
+    profile: Optional[prof_mod.BackendProfile] = None
+    #: predicted executables for this program family
+    #: (stability.ExecutablePrediction), carried for the JSON artifact
+    executables: Optional[object] = None
+
+    def per_kind(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0.0) + e.per_step
+        return out
+
+    def host_ms_per_step(self) -> Optional[float]:
+        if self.profile is None:
+            return None
+        return sum(e.cost_ms(self.profile) or 0.0 for e in self.events)
+
+    def fences_per_step(self) -> float:
+        return self.fence_model.per_step_steady()
+
+    def predict_fences(self, n_steps: int, prior_boundaries: int = 0,
+                       flushes: int = 0) -> int:
+        return self.fence_model.count(n_steps,
+                                      prior_boundaries=prior_boundaries,
+                                      flushes=flushes)
+
+    # ------------------------------------------------------------ rendering
+    def format_summary(self) -> str:
+        pk = self.per_kind()
+        t = self.host_ms_per_step()
+        t_s = f", predicted host time {t:.3f} ms/step" if t is not None \
+            else ""
+        return (f"host/step: {pk.get('dispatch', 0):g} dispatch(es), "
+                f"{self.fences_per_step():g} fence(s), "
+                f"{pk.get('transfer', 0):g} transfer(s), "
+                f"{pk.get('callback', 0):g} callback(s){t_s}")
+
+    def format_table(self) -> str:
+        name = self.profile.name if self.profile else "<none>"
+        lines = [f"dispatch plan [{self.subject}]  profile {name}",
+                 f"{'kind':<9} {'event':<22} {'per step':>9} "
+                 f"{'ms/step':>9}  note"]
+        for e in self.events:
+            c = e.cost_ms(self.profile)
+            lines.append(
+                f"{e.kind:<9} {e.label:<22} {e.per_step:>9.3g} "
+                f"{(f'{c:9.4f}' if c is not None else '        -')}  "
+                f"{e.note}")
+        t = self.host_ms_per_step()
+        if t is not None:
+            lines.append(f"{'total':<9} {'':<22} {'':>9} {t:>9.4f}")
+        return "\n".join(lines)
+
+    def to_report(self) -> R.Report:
+        rep = R.Report(subject=self.subject)
+        rep.add("dispatch.report", R.INFO, self.format_summary(),
+                path=self.subject, pass_name="dispatch")
+        steady = [e for e in self.events
+                  if e.kind == "fence" and e.per_step >= 1.0
+                  and e.removable]
+        if steady:
+            names = ", ".join(e.label for e in steady)
+            rep.add(
+                "dispatch.fence-per-step", R.WARNING,
+                f"{self.subject} takes {sum(e.per_step for e in steady):g} "
+                f"deliberate host fence(s) on EVERY step ({names}): each "
+                f"one serializes host dispatch with device execution — a "
+                f"fixed per-step cost gradient accumulation cannot "
+                f"amortize (WALLCLOCK §7).  The metric spool removes the "
+                f"per-boundary reads (observability.report_window); the "
+                f"fp16/nan-sentinel overflow read WITH an LR scheduler is "
+                f"the documented exception (docs/observability.md)",
+                path=self.subject, pass_name="dispatch")
+        for e in self.events:
+            if e.kind == "callback" and e.per_step >= 1.0:
+                rep.add(
+                    "dispatch.callback-per-step", R.WARNING,
+                    f"{self.subject}: {e.label} crosses the host on every "
+                    f"step (report_window=1 turns the once-per-window "
+                    f"drain into a per-step crossing) — raise "
+                    f"observability.report_window",
+                    path=self.subject, pass_name="dispatch")
+        return rep
+
+    def to_json(self) -> dict:
+        out = {
+            "subject": self.subject,
+            "profile": self.profile.name if self.profile else None,
+            "predicted_host_ms_per_step": self.host_ms_per_step(),
+            "fences_per_step": self.fences_per_step(),
+            "per_kind": self.per_kind(),
+            "events": [{
+                "kind": e.kind, "label": e.label, "per_step": e.per_step,
+                "n_leaves": e.n_leaves, "bytes_per": e.bytes_per,
+                "ms_per_step": e.cost_ms(self.profile), "note": e.note,
+            } for e in self.events],
+            "fence_model": {
+                "per_boundary": self.fence_model.per_boundary,
+                "tput_report": self.fence_model.tput_report,
+                "steps_per_output": self.fence_model.steps_per_output,
+                "start_step": self.fence_model.start_step,
+                "flush_fences": self.fence_model.flush_fences,
+            },
+        }
+        if self.executables is not None:
+            out["executables"] = self.executables.to_json()
+        return out
+
+
+# -------------------------------------------------------------- byte helpers
+
+def _tree_bytes(tree) -> int:
+    # memplan.nbytes is the ONE byte model for the analysis package
+    # (symbolic-dim guards, abstract-leaf handling)
+    from deepspeed_tpu.analysis import memplan
+    return sum(memplan.nbytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def _n_leaves(args) -> int:
+    return sum(len(jax.tree_util.tree_leaves(a)) for a in args)
+
+
+# ------------------------------------------------------------- engine plans
+
+def plan_engine_dispatch(engine, batch, fused: bool = True,
+                         profile: Optional[prof_mod.BackendProfile] = None
+                         ) -> DispatchPlan:
+    """Static host timeline of one optimizer step for ``batch``'s format.
+
+    Models exactly what the engine's hot path does per boundary: the
+    program dispatch(es), the deliberate fences (cross-checked against
+    the ``fences.py`` counter by the contract test), the host→device
+    stagings, and the spool's once-per-window drain crossing.
+
+    ``batch`` follows the matching call protocol: the FULL effective
+    batch for ``fused=True`` (what ``train_batch()`` takes — one staging
+    per step) and ONE MICRO batch for ``fused=False`` (what ``forward()``
+    takes — ``gas`` stagings per step), which is exactly what the
+    engine's build-time gate passes from each path."""
+    from deepspeed_tpu import analysis
+    from deepspeed_tpu.analysis import stability
+
+    if profile is None:
+        profile = prof_mod.default_profile()
+    batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
+    gas = engine.gradient_accumulation_steps()
+    spool = getattr(engine, "_spool", None)
+    window = int(getattr(engine.config, "observability_report_window", 0))
+    tele = engine._telemetry
+    skip_contract = bool(engine.config.fp16_enabled
+                         or engine._nan_sentinel)
+    deferred = bool(skip_contract and tele.defers_overflow(engine))
+    wcb = bool(engine.wall_clock_breakdown())
+    has_writer = engine.summary_writer is not None
+    has_sched = engine.lr_scheduler is not None
+    n_groups = len(engine._group_defs)
+
+    events: List[DispatchEvent] = []
+    per_boundary_fences = 0
+
+    if fused:
+        args = analysis.train_batch_args(engine, batch)
+        events.append(DispatchEvent(
+            "dispatch", "train_batch", 1.0, n_leaves=_n_leaves(args),
+            note="fwd+bwd+boundary in ONE program (gas folds into the "
+                 "scan)"))
+        events.append(DispatchEvent(
+            "transfer", "batch", 1.0, bytes_per=_tree_bytes(batch),
+            note="full effective batch staged per step"))
+    else:
+        fb_args = (engine.params, engine.loss_scale_state.cur_scale, batch)
+        events.append(DispatchEvent(
+            "dispatch", "fwdbwd", float(gas), n_leaves=_n_leaves(fb_args),
+            note="one fused fwd+bwd program per micro step"))
+        n_grad_leaves = len(jax.tree_util.tree_leaves(engine.params))
+        if gas > 1:
+            events.append(DispatchEvent(
+                "dispatch", "grad-accumulate",
+                float((gas - 1) * n_grad_leaves),
+                n_leaves=2,
+                note="host-driven jnp.add per grad leaf per extra micro "
+                     "step (the fused path folds this into the scan)"))
+        st_args = analysis.step_args(
+            engine, jax.tree_util.tree_map(lambda x: x, engine.params))
+        events.append(DispatchEvent(
+            "dispatch", "step", 1.0, n_leaves=_n_leaves(st_args),
+            note="boundary update program"))
+        events.append(DispatchEvent(
+            "transfer", "batch", float(gas),
+            bytes_per=_tree_bytes(batch),
+            note="one micro batch staged per forward"))
+        if has_writer and spool is None:
+            per_boundary_fences += 1
+            events.append(DispatchEvent(
+                "fence", "tb-loss-read", 1.0,
+                note="float(loss) for the TensorBoard train_loss scalar "
+                     "(spooled when report_window >= 1)"))
+        if wcb:
+            per_boundary_fences += 2 * gas
+            events.append(DispatchEvent(
+                "fence", "wcb-timers", float(2 * gas),
+                note="wall_clock_breakdown syncs backward_inner + "
+                     "backward_reduce every micro step"))
+
+    # hyper staging: ONE cached [4, G] device array; re-staged only when a
+    # scheduler moved a value (engine._current_hypers)
+    events.append(DispatchEvent(
+        "transfer", "hypers", 1.0 if has_sched else 0.0,
+        bytes_per=16 * max(1, n_groups),
+        note="[4, G] stacked hypers; 0 transfers when no scheduler moves "
+             "the values"))
+
+    if skip_contract and not deferred:
+        per_boundary_fences += 1
+        events.append(DispatchEvent(
+            "fence", "overflow-read", 1.0,
+            note="fp16/nan-sentinel skip contract host read"
+                 + (" (retained: LR scheduler gates on it — the "
+                    "documented exception)" if spool is not None else
+                    "; deferred to the window drain when the spool is on"
+                    )))
+
+    flush_fences = 0
+    if spool is not None:
+        if not fused:
+            events.append(DispatchEvent(
+                "dispatch", "spool-append", 1.0, n_leaves=6,
+                note="split-API ring append (folded into train_batch on "
+                     "the fused path)"))
+        events.append(DispatchEvent(
+            "dispatch", "spool-drain", 1.0 / max(1, window), n_leaves=2,
+            note="drain program dispatch, once per report window"))
+        events.append(DispatchEvent(
+            "callback", "spool-drain", 1.0 / max(1, window),
+            note="ONE async batched io_callback per report window"))
+        flush_fences = 1
+
+    # the throughput reporter only fences when something DRIVES the
+    # timer: start() is called per batch by the engine dataloader
+    # (data.py), never by the engine itself — a custom loop feeding
+    # train_batch() directly never starts it, and stop() no-ops unstarted
+    # (timer.py).  Condition on the loader (or a timer someone already
+    # started), or predict_fences would count report fences FENCE_COUNT
+    # never records.
+    timer_driven = (getattr(engine, "training_dataloader", None) is not None
+                    or bool(getattr(engine.tput_timer, "initialized",
+                                    False)))
+    tput_report = spool is None and timer_driven
+    fence_model = FenceModel(
+        per_boundary=per_boundary_fences,
+        tput_report=tput_report,
+        steps_per_output=int(getattr(engine.tput_timer, "steps_per_output",
+                                     0) or 0),
+        start_step=int(getattr(engine.tput_timer, "start_step", 2)),
+        flush_fences=flush_fences)
+    if tput_report and fence_model.steps_per_output > 0:
+        events.append(DispatchEvent(
+            "fence", "tput-report",
+            1.0 / fence_model.steps_per_output,
+            note="throughput reporter fences on report boundaries only "
+                 "(PR 1 window accounting)"))
+
+    kind = "train_batch" if fused else "fwdbwd+step"
+    pred = stability.predict_executables(engine, [batch], train=True,
+                                         fused=fused)
+    return DispatchPlan(subject=kind, events=events,
+                        fence_model=fence_model, profile=profile,
+                        executables=pred)
+
+
+def plan_serve_dispatch(engine,
+                        profile: Optional[prof_mod.BackendProfile] = None
+                        ) -> Dict[str, DispatchPlan]:
+    """Static host timelines of the serving engine: one plan per program
+    ("step" = one prefill admission / one decode iteration across all
+    slots).  The per-iteration logits read is the sampler's data
+    dependency — a priced, counted fence, not a removable one."""
+    from deepspeed_tpu.analysis import stability
+
+    if profile is None:
+        profile = prof_mod.default_profile()
+    pred = stability.predict_executables_serve(engine)
+    slots = engine.num_slots
+    vocab = int(getattr(engine.module.config, "vocab_size", 0) or 0)
+
+    prefill_args = engine._program_args("prefill")
+    prefill = DispatchPlan(
+        subject="prefill",
+        events=[
+            DispatchEvent("dispatch", "prefill", 1.0,
+                          n_leaves=_n_leaves(prefill_args),
+                          note="one executable for EVERY prompt length "
+                               "(host-side bucket padding)"),
+            DispatchEvent("transfer", "prompt", 1.0,
+                          bytes_per=4 * engine.prefill_bucket,
+                          note="padded [1, bucket] token ids"),
+            DispatchEvent("fence", "logits-read", 1.0,
+                          bytes_per=4 * vocab, removable=False,
+                          note="sampler data dependency: the first "
+                               "generated token's distribution"),
+        ],
+        fence_model=FenceModel(per_boundary=1),
+        profile=profile, executables=pred)
+
+    decode = DispatchPlan(
+        subject="decode",
+        events=[
+            DispatchEvent("dispatch", "decode", 1.0,
+                          n_leaves=_n_leaves(
+                              engine._program_args("decode")),
+                          note="one token step across ALL slots"),
+            DispatchEvent("transfer", "tokens+active", 1.0,
+                          bytes_per=5 * slots,
+                          note="per-slot input token + active mask"),
+            DispatchEvent("fence", "logits-read", 1.0,
+                          bytes_per=4 * vocab * slots, removable=False,
+                          note="sampler data dependency, every "
+                               "iteration"),
+        ],
+        fence_model=FenceModel(per_boundary=1),
+        profile=profile, executables=pred)
+    return {"prefill": prefill, "decode": decode}
+
+
+def serve_predict_fences(plans: Dict[str, DispatchPlan], prefills: int,
+                         decode_iters: int) -> int:
+    """Predicted fence-counter delta for a serving run: one counted
+    logits read per prefill admission and per decode iteration."""
+    return (plans["prefill"].predict_fences(prefills)
+            + plans["decode"].predict_fences(decode_iters))

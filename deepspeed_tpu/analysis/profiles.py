@@ -53,6 +53,33 @@ class BackendProfile:
     #: half-precision GEMM.  The memory model must count those copies on
     #: CPU and must NOT count them on TPU.
     lowp_dot_f32_copies: bool = False
+    #: runtime quirk: executables DESERIALIZED from the persistent
+    #: compilation cache lose donated-buffer aliasing and compute garbage
+    #: (observed on jax 0.4.x XLA-CPU — the resume-bench incident that
+    #: introduced ``DSTPU_NO_DONATE``, docs/resilience.md).  On a
+    #: quirk-listed backend the engine auto-skips donation whenever the
+    #: persistent cache is enabled, and the compile-stability pass flags
+    #: the combination (``stability.donation-cache-quirk``) if forced.
+    persistent_cache_donation_unsafe: bool = False
+    # ---- host-boundary cost constants (dispatchplan.py).  NOMINAL
+    # figures, like the bandwidths above: the dispatch microbench
+    # (``BENCH_DISPATCH=1`` → bench_dispatch.json) carries measured
+    # columns next to these predictions so each rig can be calibrated.
+    #: base host cost of launching ONE compiled program (runtime call +
+    #: argument handling), microseconds
+    dispatch_us: float = 100.0
+    #: additional per-argument-leaf dispatch cost (pytree flattening +
+    #: buffer table marshalling scale with the argument count)
+    dispatch_leaf_us: float = 1.0
+    #: host cost of one deliberate fence — a device round trip the host
+    #: blocks on (``block_until_ready`` / scalar read), microseconds
+    fence_us: float = 300.0
+    #: host cost of one in-graph host-callback crossing (the telemetry
+    #: spool drain), microseconds
+    callback_us: float = 500.0
+    #: host→device staging bandwidth, GiB/s (batch feeding, hyper
+    #: staging — PCIe-class on real chips, memcpy on CPU)
+    h2d_gibps: float = 10.0
 
     @property
     def hbm_bytes(self) -> int:
@@ -76,7 +103,12 @@ PROFILES: Dict[str, BackendProfile] = {
         peak_bf16_tflops=459.0),
     "cpu-8": BackendProfile(
         name="cpu-8", hbm_gib=4.0, ici_gibps=10.0, dcn_gibps=10.0,
-        peak_bf16_tflops=1.0, lowp_dot_f32_copies=True),
+        peak_bf16_tflops=1.0, lowp_dot_f32_copies=True,
+        persistent_cache_donation_unsafe=True,
+        # host == device: no PCIe hop, no device round trip — the
+        # BENCH_DISPATCH cpu rows calibrate these
+        dispatch_us=60.0, dispatch_leaf_us=1.0, fence_us=30.0,
+        callback_us=200.0, h2d_gibps=8.0),
 }
 
 #: axes that cross DCN when the mesh spans hosts (docs/scaling.md: data

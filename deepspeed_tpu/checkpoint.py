@@ -1545,9 +1545,16 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.skipped_steps = int(state["skipped_steps"])
     engine.micro_steps = int(state["micro_steps"])
 
-    # loss scale
+    # loss scale — through _put_global, NOT a bare jnp.asarray: the
+    # engine pins these leaves committed+replicated at build, and an
+    # unpinned restore would hash a DIFFERENT executable key than the
+    # cached step program, so every resume would pay a recompile the
+    # persistent cache can never serve (the same stability.unpinned-
+    # sharding class as the opt_state.step incident; pinned by
+    # test_compile_cache_hits_after_restore)
+    old_ls = engine.loss_scale_state._asdict()
     engine.loss_scale_state = type(engine.loss_scale_state)(
-        **{k: jnp.asarray(v)
+        **{k: _put_global(old_ls[k], np.asarray(v))
            for k, v in state["loss_scale_state"].items()})
 
     for live, saved in zip(engine.optimizer.param_groups,

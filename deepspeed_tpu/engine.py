@@ -640,6 +640,16 @@ class DeepSpeedTpuEngine:
         else:
             self._ls_variant = prec.INLINE
             self.loss_scale_state = prec.static_loss_scale_state(1.0)
+        # pin the loss-scale leaves to the mesh NOW (committed, replicated):
+        # as fresh jnp scalars they are UNCOMMITTED single-device arrays,
+        # which hash a DIFFERENT executable key than the committed
+        # NamedSharding the step program's outputs carry — so the second
+        # boundary used to re-lower (and re-compile) the whole step
+        # program once per run (stability.unpinned-sharding; pinned by
+        # tests/test_dispatch_stability.py)
+        self.loss_scale_state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._named(P())),
+            self.loss_scale_state)
 
         # -- resilience (docs/resilience.md): NaN/Inf sentinel extends the
         #    fp16 skip-on-overflow contract to bf16/fp32 boundaries; the
@@ -1576,6 +1586,28 @@ class DeepSpeedTpuEngine:
                                    profile=profile,
                                    budget_bytes=budget_bytes)
 
+    def run_stability(self, batch, train: bool = True, fused: bool = True):
+        """Compile-stability report for ``batch``'s format
+        (:mod:`deepspeed_tpu.analysis.stability` — the PR 5/PR 10 hazard
+        classes as build-time findings; the CLI and test surface, ignores
+        ``analysis.mode``)."""
+        from deepspeed_tpu.analysis import stability as stab
+        rep = stab.check_engine(self, _as_tuple(batch), fused=fused,
+                                train=train)
+        return rep.filtered(self._analysis_suppress)
+
+    def plan_dispatch(self, batch, fused: bool = True, profile=None):
+        """Static host timeline of one optimizer step for ``batch``'s
+        format — :class:`deepspeed_tpu.analysis.DispatchPlan` (program
+        dispatches, deliberate fences cross-checked against the
+        ``fences.py`` counter, host→device stagings, callback crossings),
+        priced via the backend profile's dispatch-overhead constants."""
+        from deepspeed_tpu.analysis import dispatchplan, profiles
+        if profile is None and self.config.analysis_profile:
+            profile = profiles.resolve(self.config.analysis_profile)
+        return dispatchplan.plan_engine_dispatch(
+            self, _as_tuple(batch), fused=fused, profile=profile)
+
     def _donate_argnums(self, fused):
         """jit donation of the step programs — the single source both the
         builders (_build_train_batch/_build_step) and the capacity
@@ -1590,23 +1622,49 @@ class DeepSpeedTpuEngine:
         deserialize donated-buffer executables from the persistent
         compile cache with broken aliasing, so a cache-HIT step silently
         computes garbage — bench.py's resume leg detects the garbage and
-        names this switch."""
+        names this switch.  That combination is now auto-avoided: on a
+        backend whose profile declares
+        ``persistent_cache_donation_unsafe`` (analysis/profiles.py) the
+        engine skips donation whenever the persistent compile cache is
+        enabled, and the compile-stability pass flags any forced
+        re-combination (``stability.donation-cache-quirk``;
+        ``DSTPU_FORCE_DONATE=1`` overrides the skip to reproduce)."""
         if os.environ.get("DSTPU_NO_DONATE", "") == "1":
             return ()
+        if os.environ.get("DSTPU_FORCE_DONATE", "") != "1":
+            from deepspeed_tpu.analysis import profiles as prof_mod
+            from deepspeed_tpu.utils import compile_cache
+            prof = prof_mod.default_profile()
+            if (compile_cache.enabled_dir() is not None and prof is not None
+                    and prof.persistent_cache_donation_unsafe):
+                if not getattr(self, "_warned_donate_quirk", False):
+                    self._warned_donate_quirk = True
+                    logger.warning(
+                        "donation DISABLED: the persistent compile cache "
+                        "is enabled and backend profile '%s' declares "
+                        "deserialized donated-buffer executables unsafe "
+                        "(the PR 10 garbage-compute incident; "
+                        "docs/resilience.md).  DSTPU_FORCE_DONATE=1 "
+                        "overrides", prof.name)
+                return ()
         if fused:
             return ((2, 3) if self.policy.compute_dtype == jnp.float32
                     else (0, 1, 2, 3))
         return ((1, 2, 3) if self.policy.compute_dtype == jnp.float32
                 else (0, 1, 2, 3))
 
-    def _maybe_capacity_plan(self, kind, key, run):
+    def _maybe_capacity_plan(self, kind, key, run, batch=None):
         """Run the capacity planner once per (program kind, batch format)
         and dispatch per ``analysis.mode`` through the same
         :func:`~deepspeed_tpu.analysis.dispatch_report` gate as graph
         lint — 'error' mode raises
         :class:`~deepspeed_tpu.analysis.MemoryPlanError` at build time.
         Planner failures warn and move on — the planner must never take
-        down a healthy build."""
+        down a healthy build.  When ``batch`` is given the
+        compile-stability and dispatch-cost passes ride the same gate:
+        their ``stability.*`` / ``dispatch.*`` findings join the report
+        tree (same mode/suppress machinery, docs/analysis.md "Dispatch &
+        compile-stability")."""
         mode = self._analysis_mode
         if mode == "off" or (kind, key) in self._planned_keys:
             return
@@ -1622,6 +1680,21 @@ class DeepSpeedTpuEngine:
             logger.warning("capacity plan could not analyze %s: %s",
                            kind, e)
             return
+        if batch is not None:
+            try:
+                from deepspeed_tpu.analysis import dispatchplan
+                from deepspeed_tpu.analysis import stability as stab
+                train = kind != "eval"
+                fused = kind == "train_batch"
+                rep.extend(stab.check_engine(self, batch, fused=fused,
+                                             train=train))
+                if train:
+                    dplan = dispatchplan.plan_engine_dispatch(
+                        self, batch, fused=fused, profile=plan.profile)
+                    rep.extend(dplan.to_report())
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("stability/dispatch analysis could not "
+                               "run for %s: %s", kind, e)
         rep = rep.filtered(self._analysis_suppress)
         try:
             graph_lint.dispatch_report(
@@ -1731,7 +1804,8 @@ class DeepSpeedTpuEngine:
                 lambda: graph_lint.analyze_engine(self, batch, train=True))
             self._maybe_capacity_plan(
                 "train", key,
-                lambda: self.plan_capacity(batch, train=True, fused=False))
+                lambda: self.plan_capacity(batch, train=True, fused=False),
+                batch=batch)
             if self._loss_treedef is None:
                 loss_shape, _ = jax.eval_shape(
                     self._fwdbwd_fn, self.params,
@@ -1762,7 +1836,8 @@ class DeepSpeedTpuEngine:
                 lambda: graph_lint.analyze_engine(self, batch, train=False))
             self._maybe_capacity_plan(
                 "eval", key,
-                lambda: self.plan_capacity(batch, train=False))
+                lambda: self.plan_capacity(batch, train=False),
+                batch=batch)
             with _annotate("eval"):
                 loss = self._eval_fn(self.params, batch)
             self._last_loss = loss
@@ -2620,7 +2695,8 @@ class DeepSpeedTpuEngine:
             lambda: graph_lint.analyze_engine_train_batch(self, batch))
         self._maybe_capacity_plan(
             "train_batch", key,
-            lambda: self.plan_capacity(batch, train=True, fused=True))
+            lambda: self.plan_capacity(batch, train=True, fused=True),
+            batch=batch)
         spool = self._spool
         if spool is not None:
             self._telemetry.note_spool_base_step(self.global_steps)

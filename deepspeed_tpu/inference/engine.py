@@ -44,6 +44,7 @@ from deepspeed_tpu import analysis as graph_lint
 from deepspeed_tpu import checkpoint
 from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.inference import kvcache, quant
+from deepspeed_tpu.observability import fences as obs_fences
 from deepspeed_tpu.parallel.topology import MODEL_AXIS, make_mesh
 
 logger = logging.getLogger(__name__)
@@ -331,6 +332,38 @@ class InferenceEngine:
                 closed, mesh_axes=mesh_axes, subject=kind))
         return rep.filtered(self.config.graph_lint_suppress)
 
+    def run_stability(self, prompt_lengths=()) -> graph_lint.Report:
+        """Compile-stability report: the "exactly two executables"
+        promise as a CHECKED invariant — the prefill call-path signature
+        (via :meth:`_pad_prompt`, the marshalling production uses) must
+        be identical across prompt lengths — plus weight/cache sharding
+        pins and the donation × persistent-cache quirk
+        (docs/analysis.md "Dispatch & compile-stability")."""
+        from deepspeed_tpu.analysis import stability as stab
+        rep = stab.check_inference_engine(
+            self, prompt_lengths=prompt_lengths)
+        return rep.filtered(self.config.analysis_suppress)
+
+    def predict_executables(self):
+        """:class:`deepspeed_tpu.analysis.ExecutablePrediction` — always
+        exactly 2 (prefill + decode); the contract test pins the measured
+        ``compile_cache_misses`` against it."""
+        from deepspeed_tpu.analysis import stability as stab
+        return stab.predict_executables_serve(self)
+
+    def plan_dispatch(self, profile=None):
+        """Static host timelines of the serving hot path:
+        ``{"prefill": DispatchPlan, "decode": DispatchPlan}`` — one
+        dispatch + token staging + the sampler's logits read per
+        iteration, priced via the backend profile's dispatch constants
+        (every logits read is a counted fence, so the prediction is
+        checkable against ``observability.fences.FENCE_COUNT``)."""
+        from deepspeed_tpu.analysis import dispatchplan
+        from deepspeed_tpu.analysis import profiles as prof_mod
+        if profile is None:
+            profile = self._explicit_profile or prof_mod.default_profile()
+        return dispatchplan.plan_serve_dispatch(self, profile=profile)
+
     def plan_capacity(self, profile=None, budget_gb=None):
         """Static capacity plan of the prefill + decode programs plus the
         persistent weights + KV cache — the serving analog of
@@ -391,8 +424,20 @@ class InferenceEngine:
         if amode != "off":
             try:
                 plan = self.plan_capacity()
-                rep = plan.to_report(subject="serve").filtered(
-                    self.config.analysis_suppress)
+                rep = plan.to_report(subject="serve")
+                # the stability + dispatch passes ride the same analysis
+                # gate (docs/analysis.md "Dispatch & compile-stability"):
+                # the exactly-two-executables invariant, sharding pins,
+                # the donation quirk, and the priced host timeline
+                try:
+                    rep.extend(self.run_stability())
+                    for p in self.plan_dispatch(
+                            profile=plan.profile).values():
+                        rep.extend(p.to_report())
+                except Exception as e:  # pragma: no cover - defensive
+                    logger.warning("stability/dispatch analysis could "
+                                   "not run for the serve programs: %s", e)
+                rep = rep.filtered(self.config.analysis_suppress)
             except graph_lint.GraphLintError:
                 raise
             except Exception as e:  # pragma: no cover - defensive
@@ -433,6 +478,17 @@ class InferenceEngine:
         self._cache = self._place(kvcache.init_cache(self.cache_spec),
                                   self._cache_specs)
 
+    def _pad_prompt(self, prompt_tokens):
+        """Host-side bucket padding — THE mechanism behind the
+        one-prefill-executable promise: every admissible prompt length
+        maps to the SAME ``[1, bucket]`` int32 call signature (the
+        compile-stability pass checks this invariant across lengths
+        through this very helper).  Returns ``(padded, length)``."""
+        toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        padded = np.zeros((1, self.prefill_bucket), np.int32)
+        padded[0, :toks.size] = toks
+        return padded, np.int32(toks.size)
+
     def prefill(self, slot: int, prompt_tokens) -> np.ndarray:
         """Prefill ``prompt_tokens`` into cache ``slot``; returns the
         full-vocab logits row of the last prompt token (the first
@@ -447,14 +503,16 @@ class InferenceEngine:
                 f"inference.prefill_bucket/max_tokens")
         if not (0 <= int(slot) < self.num_slots):
             raise ValueError(f"slot {slot} outside [0, {self.num_slots})")
-        padded = np.zeros((1, self.prefill_bucket), np.int32)
-        padded[0, :toks.size] = toks
+        padded, length = self._pad_prompt(toks)
         t0 = time.perf_counter()
         logits, k, v, pos = self._prefill_fn(
             self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], padded, np.int32(slot),
-            np.int32(toks.size))
-        out = np.asarray(logits, np.float32)[0]
+            self._cache["pos"], padded, np.int32(slot), length)
+        # the sampler's data dependency: ONE counted fence per admission
+        # (observability/fences.py — the dispatch plan predicts exactly
+        # this counter, tests/test_dispatch_stability.py)
+        out = np.asarray(obs_fences.read_arrays(logits)[0],
+                         np.float32)[0]
         self._cache = {"k": k, "v": v, "pos": pos}
         if self.first_token_ts is None:
             self.first_token_ts = time.time()
@@ -471,7 +529,9 @@ class InferenceEngine:
             self._cache["pos"], np.asarray(tokens, np.int32),
             np.asarray(active, bool))
         self._cache = {"k": k, "v": v, "pos": pos}
-        return np.asarray(logits, np.float32)
+        # one counted fence per decode iteration (sampler dependency;
+        # the dispatch plan's predicted fence counter)
+        return np.asarray(obs_fences.read_arrays(logits)[0], np.float32)
 
     def slot_positions(self) -> np.ndarray:
         return np.asarray(self._cache["pos"])

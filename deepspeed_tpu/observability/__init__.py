@@ -166,6 +166,18 @@ class Telemetry:
         self._anomaly: Optional[detectors.WindowAnomalyDetector] = None
         if self.window >= 1:
             self.spool = MetricSpool(self.window, self._on_window)
+            # pin the fresh ring state to the engine mesh (committed,
+            # replicated): as plain jnp.zeros it is UNCOMMITTED, and the
+            # fused train_batch's first call would hash a different
+            # executable key than every later call (whose spool args are
+            # the committed program outputs) — one silent re-lower per
+            # run, the stability.unpinned-sharding class
+            # (tests/test_dispatch_stability.py pins the fix)
+            from jax.sharding import NamedSharding, PartitionSpec
+            self.spool.state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    x, NamedSharding(engine.mesh, PartitionSpec())),
+                self.spool.state)
             self._anomaly = detectors.WindowAnomalyDetector(
                 self._rank,
                 spike_factor=cfg.observability_spike_factor,

@@ -66,6 +66,19 @@ def worker_env(pid: int, world_size: int, port: int, local_devices: int,
 #: gloo, which removes most of these.)
 _GLOO_FLAKE_MARKER = "gloo::EnforceNotMet"
 
+#: rendezvous-phase flakes retried the same way: under heavy contention
+#: the jax.distributed/gloo RENDEZVOUS itself can miss its deadline or
+#: fail the full-mesh connect before any test logic runs — same
+#: infra-flake class as the mid-stream corruption, same bounded retry on
+#: fresh ports.  Markers are deliberately narrow (transport/coordination
+#: strings), so a real assertion failure always surfaces.
+_GLOO_FLAKE_MARKERS = (
+    _GLOO_FLAKE_MARKER,
+    "connectFullMesh",                   # gloo rendezvous connect failure
+    "DEADLINE_EXCEEDED",                 # coordination-service barrier
+    "Barrier timed out",                 # jax distributed init timeout
+)
+
 
 def spawn_distributed(func_name: str, world_size: int = 2,
                       local_devices: int = 2, timeout: float = 420.0,
@@ -92,9 +105,10 @@ def spawn_distributed(func_name: str, world_size: int = 2,
         return _spawn_distributed_once(func_name, world_size, local_devices,
                                        timeout, eff_env)
     except AssertionError as e:
-        if _retries_left > 0 and _GLOO_FLAKE_MARKER in str(e):
-            print(f"spawn_distributed({func_name!r}): gloo transport flake, "
-                  f"retrying on a fresh port "
+        if _retries_left > 0 and any(m in str(e)
+                                     for m in _GLOO_FLAKE_MARKERS):
+            print(f"spawn_distributed({func_name!r}): gloo "
+                  f"transport/rendezvous flake, retrying on a fresh port "
                   f"({_retries_left} retries left)", file=sys.stderr)
             return spawn_distributed(func_name, world_size, local_devices,
                                      timeout, env_extra,

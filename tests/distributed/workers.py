@@ -536,7 +536,7 @@ def fleet_straggler_watchdog():
     rank = jax.process_index()
     td = _test_dir()
     jsonl = os.path.join(td, "fleet.jsonl")
-    STALL_STEP, STALL_S, WD_TIMEOUT = 3, 2.5, 1.0
+    STALL_STEP = 3
 
     # nan_sentinel + LR scheduler: the documented retained-read path
     # (docs/observability.md "The scheduler exception") keeps the
@@ -556,7 +556,7 @@ def fleet_straggler_watchdog():
         "resilience": {"nan_sentinel": True},
     }
 
-    def make_engine(fleet: bool):
+    def make_engine(fleet: bool, wd_timeout: float = 0.0):
         cfg = dict(base_cfg)
         if fleet:
             cfg["observability"] = {
@@ -568,7 +568,7 @@ def fleet_straggler_watchdog():
                 "flight_recorder_dir": td,
             }
             cfg["resilience"] = {"nan_sentinel": True,
-                                 "watchdog_timeout_s": WD_TIMEOUT}
+                                 "watchdog_timeout_s": wd_timeout}
         engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=8),
                                         config=cfg)
         return engine
@@ -580,13 +580,35 @@ def fleet_straggler_watchdog():
         return x, y
 
     # baseline leg first (no observability, no chaos): the trajectory the
-    # fleet-observed run must reproduce bitwise
+    # fleet-observed run must reproduce bitwise — TIMED, because it
+    # doubles as the contention probe below
+    import time as _time
     ref_engine = make_engine(fleet=False)
-    ref_losses = [float(ref_engine.train_batch(batch(i))) for i in range(6)]
+    ref_losses, t_steps = [], []
+    for i in range(6):
+        t0 = _time.monotonic()
+        ref_losses.append(float(ref_engine.train_batch(batch(i))))
+        t_steps.append(_time.monotonic() - t0)
     ref_master = _master_bytes(ref_engine)
     _barrier("fleet_baseline_done")
 
-    engine = make_engine(fleet=True)
+    # contention-scaled deadlines (de-flake of the fixed 1.0 s / 2.5 s
+    # constants, which fired the watchdog during a slow COMPILE — not
+    # the injected stall — under full-suite host contention): the timed
+    # baseline leg measures this host's compile (step 0) and warm-step
+    # costs; the deadline sits well above both, the stall well above the
+    # deadline, and both ranks agree on the MAX over the fleet (shared
+    # files + barrier — rank 1's stall must outlive rank 0's deadline)
+    my_wd = max(1.0, 2.0 * t_steps[0], 8.0 * max(t_steps[1:]))
+    with open(os.path.join(td, f"wd_rank{rank}.txt"), "w") as f:
+        f.write(repr(my_wd))
+    _barrier("fleet_wd_measured")
+    WD_TIMEOUT = max(
+        float(open(os.path.join(td, f"wd_rank{r}.txt")).read())
+        for r in range(2))
+    STALL_S = 2.5 * WD_TIMEOUT
+
+    engine = make_engine(fleet=True, wd_timeout=WD_TIMEOUT)
     engine._watchdog.poll_s = 0.05
     if rank == 1:
         # host-side stall on rank 1 ONLY, inside the armed boundary

@@ -1,0 +1,487 @@
+"""Dispatch & compile-stability analyzer (analysis/stability.py +
+analysis/dispatchplan.py, docs/analysis.md "Dispatch & compile-stability").
+
+The verification contract (ISSUE 11): prediction drift is a TEST FAILURE,
+not a doc footnote —
+
+* predicted executable count == measured ``compile_cache_misses`` over an
+  N-step run, for the training engine (fused AND split API) and the
+  inference engine (prefill + decode across prompt lengths);
+* predicted fence count == the ``observability.fences.FENCE_COUNT``
+  pinned counter over the same runs;
+* the PR 5 class (unpinned ``opt_state.step`` sharding re-lowering the
+  boundary on every resume) and the PR 10 class (donated buffers ×
+  persistent compile cache on a quirk-listed backend computing garbage)
+  are each CAUGHT in error mode with leaf-path-bearing messages;
+* one executable per (program kind, batch format) for ALL program kinds —
+  eval and the split-API boundary included, extending the PR 1 fix — with
+  the runtime counter agreeing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu import analysis
+from deepspeed_tpu.analysis import dispatchplan, stability
+from deepspeed_tpu.observability import fences as obs_fences
+from deepspeed_tpu.resilience import COUNTERS
+from deepspeed_tpu.utils import compile_cache
+
+from simple_model import SimpleModel
+
+pytestmark = pytest.mark.analysis
+
+HIDDEN = 8
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def make_engine(cfg, seed=0):
+    engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=HIDDEN),
+                                    config=cfg)
+    return engine
+
+
+def batch(i, n=16, dtype=np.float32, hidden=HIDDEN):
+    rng = np.random.default_rng(1000 + i)
+    x = rng.normal(size=(n, hidden)).astype(dtype)
+    y = rng.integers(0, hidden, size=(n,)).astype(np.int32)
+    return (x, y)
+
+
+@pytest.fixture
+def cold_cache(tmp_path):
+    """Fresh persistent compile cache + cleared in-memory executables: the
+    state of a relaunched process, so every compile is a counted cache
+    request (the measurement side of the executable-count contract)."""
+    d = str(tmp_path / "cc")
+    compile_cache.enable(d)
+    jax.clear_caches()
+    yield d
+    compile_cache.disable()
+
+
+def _counters():
+    return (COUNTERS.compile_cache_misses, obs_fences.FENCE_COUNT)
+
+
+# =====================================================================
+# contract: predicted executables == measured misses, predicted fences
+# == the pinned counter — training engine, fused path
+# =====================================================================
+
+def test_contract_fused_fp16(cold_cache):
+    """fp16 fused path, spool off: ONE executable for N steps (the
+    loss-scale pinning fix — the state used to re-lower once when the
+    uncommitted scale leaves committed after step 1), and exactly one
+    deliberate fence per boundary (the skip-contract overflow read)."""
+    engine = make_engine(base_config(
+        fp16={"enabled": True, "loss_scale": 128.0}))
+    b = batch(0, dtype=np.float16)
+    m0, f0 = _counters()
+    N = 4
+    for i in range(N):
+        engine.train_batch(batch(i, dtype=np.float16))
+
+    pred = stability.predict_executables(engine, [b], train=True,
+                                         fused=True)
+    assert [(k, n) for k, _, n in pred.programs] == [("train_batch", 1)]
+    assert COUNTERS.compile_cache_misses - m0 == pred.total == 1
+
+    plan = engine.plan_dispatch(b, fused=True)
+    assert plan.fence_model.per_boundary == 1        # overflow read
+    assert obs_fences.FENCE_COUNT - f0 == plan.predict_fences(N) == N
+
+    # steady state: no new executables, fences stay exactly per-boundary
+    m1, f1 = _counters()
+    for i in range(N, N + 3):
+        engine.train_batch(batch(i, dtype=np.float16))
+    assert COUNTERS.compile_cache_misses - m1 == 0
+    assert obs_fences.FENCE_COUNT - f1 == plan.predict_fences(3)
+
+
+def test_contract_fused_spooled_deferred(cold_cache, tmp_path):
+    """bf16 + nan-sentinel + metric spool, no scheduler: the overflow
+    read DEFERS to the window drain — zero per-step fences, one counted
+    flush fence, and exactly train_batch + the drain program compile."""
+    engine = make_engine(base_config(
+        bf16={"enabled": True},
+        resilience={"nan_sentinel": True},
+        observability={"report_window": 3,
+                       "jsonl_path": str(tmp_path / "t.jsonl")}))
+    b = batch(0)
+    m0, f0 = _counters()
+    N = 6
+    for i in range(N):
+        engine.train_batch(batch(i))
+    engine.flush_telemetry()
+
+    pred = stability.predict_executables(engine, [b], train=True,
+                                         fused=True)
+    assert sorted(k for k, _, _ in pred.programs) == [
+        "spool_drain", "train_batch"]
+    assert COUNTERS.compile_cache_misses - m0 == pred.total == 2
+
+    plan = engine.plan_dispatch(b, fused=True)
+    assert plan.fence_model.per_boundary == 0        # deferred
+    assert plan.fence_model.flush_fences == 1
+    assert obs_fences.FENCE_COUNT - f0 \
+        == plan.predict_fences(N, flushes=1) == 1
+
+
+def test_contract_fused_retained_read_with_scheduler(cold_cache, tmp_path):
+    """The documented scheduler exception: fp16 + LR scheduler keeps the
+    per-boundary overflow read even with the spool on — the fence model
+    must predict it (and the hyper staging becomes a per-step transfer,
+    not a fence)."""
+    engine = make_engine(base_config(
+        fp16={"enabled": True, "loss_scale": 128.0},
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_num_steps": 100}},
+        observability={"report_window": 4,
+                       "jsonl_path": str(tmp_path / "t.jsonl")}))
+    b = batch(0, dtype=np.float16)
+    _, f0 = _counters()
+    N = 4
+    for i in range(N):
+        engine.train_batch(batch(i, dtype=np.float16))
+    plan = engine.plan_dispatch(b, fused=True)
+    assert plan.fence_model.per_boundary == 1        # retained read
+    assert obs_fences.FENCE_COUNT - f0 == plan.predict_fences(N) == N
+
+
+def test_contract_tput_report_cadence(cold_cache):
+    """The throughput reporter's fence rides report boundaries only
+    (PR 1 window accounting): the static FenceModel reproduces the
+    ``local_step % steps_per_output`` + ``start_step`` arithmetic
+    exactly when the engine dataloader drives the timer."""
+    engine = make_engine(base_config(
+        bf16={"enabled": True}, steps_per_print=2))
+    b = batch(0)
+    _, f0 = _counters()
+    N = 6
+    for i in range(N):
+        engine.tput_timer.start()      # what deepspeed_io does per batch
+        engine.train_batch(batch(i))
+    plan = engine.plan_dispatch(b, fused=True)
+    assert plan.fence_model.per_boundary == 0
+    assert plan.fence_model.tput_report
+    # boundaries 4 and 6 report (total > start_step=2, local % 2 == 0)
+    assert plan.predict_fences(N) == 2
+    assert obs_fences.FENCE_COUNT - f0 == 2
+
+
+# =====================================================================
+# contract: split API (fwdbwd + step)
+# =====================================================================
+
+def _split_steps(engine, batches):
+    for b in batches:
+        loss = engine(*b)
+        engine.backward(loss)
+        engine.step()
+
+
+def test_contract_split_fp16(cold_cache):
+    """Split API, fp16: fwdbwd + step = exactly two executables per
+    format (steady state compiles nothing new), one overflow-read fence
+    per boundary."""
+    engine = make_engine(base_config(
+        gradient_accumulation_steps=1,
+        fp16={"enabled": True, "loss_scale": 128.0}))
+    b = batch(0, dtype=np.float16)
+    # warm EVERYTHING (programs + incidental host-driven ops), then
+    # measure the steady state from a simulated relaunch
+    _split_steps(engine, [batch(i, dtype=np.float16) for i in range(2)])
+    jax.clear_caches()
+    m0, f0 = _counters()
+    N = 3
+    _split_steps(engine, [batch(i, dtype=np.float16)
+                          for i in range(2, 2 + N)])
+    # relaunch: every program comes back as HITS — zero misses is the
+    # PR 5 regression shape (an unpinned leaf would re-lower here)
+    assert COUNTERS.compile_cache_misses - m0 == 0
+
+    pred = stability.predict_executables(engine, [b], train=True,
+                                         fused=False)
+    assert sorted(k for k, _, _ in pred.programs) == ["fwdbwd", "step"]
+    assert pred.total == 2
+
+    plan = engine.plan_dispatch(b, fused=False)
+    assert plan.fence_model.per_boundary == 1
+    assert obs_fences.FENCE_COUNT - f0 == plan.predict_fences(N) == N
+
+
+# =====================================================================
+# satellite: one executable per (kind, format) for ALL program kinds —
+# eval and split boundary included (extends the PR 1 fix)
+# =====================================================================
+
+def test_one_executable_per_kind_and_format(cold_cache):
+    """Alternating batch FORMATS must select distinct executables —
+    exactly one per (kind, format) — for eval and the split API too, and
+    the runtime compile counter must agree with the prediction when a
+    new format appears mid-run."""
+    engine = make_engine(base_config(
+        gradient_accumulation_steps=1,
+        bf16={"enabled": True}))
+    fmt_a = batch(0)                     # [16, 8]
+    fmt_b = batch(1, n=8)                # [8, 8] — a distinct format
+
+    # ---- eval kind
+    engine.eval()
+    engine(*fmt_a)
+    m0 = COUNTERS.compile_cache_misses
+    engine(*fmt_b)
+    pred = stability.predict_executables(engine, [fmt_a, fmt_b],
+                                         train=False)
+    assert [(k, n) for k, _, n in pred.programs] == [
+        ("eval", 1), ("eval", 1)]
+    # the new format compiled exactly ONE new executable
+    assert COUNTERS.compile_cache_misses - m0 == 1
+    assert len(engine._eval_fns) == 2
+    # formats already seen compile nothing
+    m1 = COUNTERS.compile_cache_misses
+    engine(*fmt_a)
+    engine(*fmt_b)
+    assert COUNTERS.compile_cache_misses - m1 == 0
+
+    # ---- split train kinds (fwdbwd per format, ONE step program)
+    engine.train()
+    _split_steps(engine, [fmt_a])
+    m2 = COUNTERS.compile_cache_misses
+    _split_steps(engine, [fmt_b])
+    pred = stability.predict_executables(engine, [fmt_a, fmt_b],
+                                         train=True, fused=False)
+    assert sorted((k, n) for k, _, n in pred.programs) == [
+        ("fwdbwd", 1), ("fwdbwd", 1), ("step", 1)]
+    # only the new format's fwdbwd compiled — the boundary step program
+    # is format-independent and was NOT re-lowered
+    assert COUNTERS.compile_cache_misses - m2 == 1
+    assert len(engine._fwdbwd_fns) == 2
+    assert engine._step_fn is not None
+
+    # ---- fused kind
+    m3 = COUNTERS.compile_cache_misses
+    engine.train_batch(fmt_a)
+    engine.train_batch(fmt_b)
+    assert COUNTERS.compile_cache_misses - m3 == 2
+    assert len(engine._train_batch_fns) == 2
+    m4 = COUNTERS.compile_cache_misses
+    engine.train_batch(fmt_a)
+    assert COUNTERS.compile_cache_misses - m4 == 0
+
+
+# =====================================================================
+# contract: inference engine — exactly two executables, counted fences
+# =====================================================================
+
+TINY = dict(vocab_size=64, max_seq_len=32, num_layers=2, hidden_size=32,
+            num_heads=2)
+
+
+def serve_engine():
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "inference": {"max_slots": 3, "max_tokens": 16,
+                         "prefill_bucket": 8, "page_tokens": 16,
+                         "dtype": "float32"},
+           "graph_lint": "error",
+           "analysis": {"mode": "error", "profile": "v4-8"}}
+    return InferenceEngine(GPT2.from_size("tiny", **TINY), config=cfg,
+                           seed=0)
+
+
+def test_contract_serve_two_executables(cold_cache):
+    """The 'exactly two executables' promise, measured: prefills at MANY
+    prompt lengths + decode iterations compile prefill + decode and
+    NOTHING else, and every admission/iteration is one counted fence —
+    both matching the static prediction."""
+    engine = serve_engine()
+    m0, f0 = _counters()
+    lengths = [1, 3, 5, 8]
+    for slot, n in enumerate(lengths[:3]):
+        engine.prefill(slot, list(range(1, n + 1)))
+    iters = 4
+    toks = np.zeros((engine.num_slots,), np.int32)
+    active = np.array([True, True, False])
+    for _ in range(iters):
+        engine.decode(toks, active)
+    engine.prefill(0, list(range(1, lengths[3] + 1)))   # 4th length
+
+    pred = engine.predict_executables()
+    assert pred.total == 2
+    assert COUNTERS.compile_cache_misses - m0 == 2
+
+    plans = engine.plan_dispatch()
+    predicted = dispatchplan.serve_predict_fences(plans, prefills=4,
+                                                  decode_iters=iters)
+    assert obs_fences.FENCE_COUNT - f0 == predicted == 4 + iters
+
+    # the invariant is CHECKED, not assumed: the stability pass signs the
+    # prefill call path across prompt lengths through the production
+    # padding helper
+    rep = engine.run_stability(prompt_lengths=lengths)
+    assert not rep.errors, rep.format()
+
+
+def test_serve_shape_varying_detected():
+    """A shape-varying call site (what the bucket padding prevents) is a
+    stability.shape-varying ERROR naming the diverging leaf."""
+    sigs = [stability.signature_of(
+                (np.zeros((1, n), np.int32),), kind="prefill",
+                arg_labels=("tokens",))
+            for n in (4, 8)]
+    rep = analysis.Report()
+    stability.check_single_executable("prefill", sigs, rep)
+    assert [f.code for f in rep.errors] == ["stability.shape-varying"]
+    assert "tokens" in rep.errors[0].message
+    with pytest.raises(analysis.GraphLintError):
+        analysis.dispatch_report(rep, "error", where="prefill")
+
+
+# =====================================================================
+# seeded defects: the PR 5 and PR 10 classes, caught in error mode
+# =====================================================================
+
+def test_seeded_unpinned_sharding_caught():
+    """The PR 5 class: opt_state.step rebuilt by a bare jnp.asarray (an
+    uncommitted scalar vs the engine's committed replicated sharding)
+    must be an error-mode build failure naming the leaf path."""
+    import deepspeed_tpu.ops.optim as optim_mod
+    engine = make_engine(base_config(
+        fp16={"enabled": True, "loss_scale": 128.0}))
+    b = batch(0, dtype=np.float16)
+    assert not engine.run_stability(b).errors      # healthy: quiet
+
+    engine.opt_state = optim_mod.OptimizerState(
+        step=jnp.asarray(np.asarray(engine.opt_state.step)),
+        m=engine.opt_state.m, v=engine.opt_state.v)
+    rep = engine.run_stability(b)
+    errs = [f for f in rep.errors
+            if f.code == "stability.unpinned-sharding"]
+    assert errs and "opt_state.step" in errs[0].message
+    assert "opt_state.step" in errs[0].path
+    with pytest.raises(analysis.GraphLintError) as ei:
+        analysis.dispatch_report(rep, "error", where="train_batch")
+    assert "opt_state.step" in str(ei.value)
+
+
+def test_seeded_donation_cache_quirk_caught(tmp_path, monkeypatch):
+    """The PR 10 class: donation forced back on while the persistent
+    cache is enabled on the quirk-listed CPU profile — an error-mode
+    build failure naming the donated arguments; and WITHOUT the force,
+    the engine auto-skips donation (the shipped-config fix)."""
+    d = str(tmp_path / "cc")
+    try:
+        compile_cache.enable(d)
+        engine = make_engine(base_config(bf16={"enabled": True}))
+        # the fix the pass enforces: donation auto-skipped on the quirk
+        # combination (ds_config_fast_resume.json now rides this)
+        assert engine._donate_argnums(fused=True) == ()
+        assert not engine.run_stability(batch(0)).errors
+
+        monkeypatch.setenv(stability.FORCE_DONATE_ENV, "1")
+        assert engine._donate_argnums(fused=True) != ()
+        rep = engine.run_stability(batch(0))
+        errs = [f for f in rep.errors
+                if f.code == "stability.donation-cache-quirk"]
+        assert errs, rep.format()
+        assert "master" in errs[0].message      # donated-arg names
+        with pytest.raises(analysis.GraphLintError):
+            analysis.dispatch_report(rep, "error", where="train_batch")
+    finally:
+        compile_cache.disable()
+
+
+def test_quirk_not_flagged_without_cache(monkeypatch):
+    """Donation WITHOUT the persistent cache is fine on every backend —
+    the quirk finding needs the combination."""
+    monkeypatch.setenv(stability.FORCE_DONATE_ENV, "1")
+    engine = make_engine(base_config(bf16={"enabled": True}))
+    assert engine._donate_argnums(fused=True) != ()
+    assert not engine.run_stability(batch(0)).errors
+
+
+# =====================================================================
+# wiring: the analysis-gate path and suppression
+# =====================================================================
+
+def test_stability_rides_analysis_gate():
+    """stability.* findings ride the engine's analysis.mode gate: a
+    seeded defect raises at step-build time in error mode (once the
+    format re-plans)."""
+    import deepspeed_tpu.ops.optim as optim_mod
+    engine = make_engine(base_config(
+        bf16={"enabled": True}, analysis={"mode": "error"}))
+    engine.train_batch(batch(0))           # clean build passes the gate
+    engine.opt_state = optim_mod.OptimizerState(
+        step=jnp.asarray(np.asarray(engine.opt_state.step)),
+        m=engine.opt_state.m, v=engine.opt_state.v)
+    with pytest.raises(analysis.GraphLintError) as ei:
+        engine.train_batch(batch(1, n=32))  # new format → gate re-runs
+    assert "opt_state.step" in str(ei.value)
+
+
+def test_suppression_is_exact_rule():
+    """Suppressing ``stability.unpinned`` must NOT silence
+    ``stability.unpinned-sharding`` (the PR 2 dotted-prefix contract)."""
+    rep = analysis.Report()
+    rep.add("stability.unpinned-sharding", analysis.ERROR, "x")
+    assert len(rep.filtered(["stability.unpinned"]).errors) == 1
+    assert len(rep.filtered(["stability.unpinned-sharding"]).errors) == 0
+    assert len(rep.filtered(["stability"]).errors) == 0
+
+
+def test_dispatch_plan_report_and_json():
+    """dispatch.* findings + JSON artifact shape."""
+    engine = make_engine(base_config(
+        fp16={"enabled": True, "loss_scale": 128.0}))
+    plan = engine.plan_dispatch(batch(0, dtype=np.float16), fused=True)
+    rep = plan.to_report()
+    assert any(f.code == "dispatch.report" for f in rep.infos)
+    assert any(f.code == "dispatch.fence-per-step" for f in rep.warnings)
+    doc = plan.to_json()
+    assert doc["fences_per_step"] >= 1.0
+    assert doc["executables"]["total"] == 1
+    assert doc["predicted_host_ms_per_step"] is None or \
+        doc["predicted_host_ms_per_step"] > 0
+    assert {e["kind"] for e in doc["events"]} >= {"dispatch", "fence"}
+
+
+def test_split_plan_micro_batch_convention():
+    """fused=False takes ONE MICRO batch (the forward() protocol — what
+    the engine's build-time gate passes): gas stagings per step, each of
+    the full micro-batch bytes — not divided by gas again."""
+    engine = make_engine(base_config(bf16={"enabled": True}))   # gas=2
+    micro = batch(0, n=8)
+    plan = engine.plan_dispatch(micro, fused=False)
+    ev = {e.label: e for e in plan.events if e.kind == "transfer"}
+    assert ev["batch"].per_step == 2.0
+    assert ev["batch"].bytes_per == sum(x.nbytes for x in micro)
+
+
+def test_report_window_one_warns():
+    """report_window=1 turns the once-per-window drain into a per-step
+    host crossing — flagged, never silently accepted."""
+    engine = make_engine(base_config(
+        bf16={"enabled": True},
+        observability={"report_window": 1}))
+    plan = engine.plan_dispatch(batch(0), fused=True)
+    rep = plan.to_report()
+    assert any(f.code == "dispatch.callback-per-step"
+               for f in rep.warnings)
