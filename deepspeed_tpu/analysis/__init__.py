@@ -57,7 +57,8 @@ __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Report", "GraphLintError",
     "MemoryPlanError", "ShardSpecError", "MODES", "analyze_jaxpr",
     "analyze_step", "analyze_engine", "analyze_engine_train_batch",
-    "trace_train_batch", "train_batch_args", "step_args",
+    "analyze_engine_train_many", "trace_train_batch", "train_batch_args",
+    "train_many_args", "step_args",
     "check_shard_specs",
     "validate_specs_or_raise", "dispatch_report",
     "CapacityPlan", "ProgramPlan", "analyze_program", "plan_engine",
@@ -190,6 +191,55 @@ def train_batch_args(engine, batch):
     if spool is not None:
         args = args + (spool.state,)
     return args
+
+
+def train_many_args(engine, batches):
+    """The K-fused ``train_many`` call tuple with the engine's CURRENT
+    state — single owner like :func:`train_batch_args`.  ``batches`` is
+    the sequence of K per-step batch tuples (separate program arguments,
+    NOT a stacked tree — see ``engine._build_train_many`` for why); the
+    hyper slot carries the staged ``[K, 4, G]`` block, and with the
+    metric spool on the tuple grows the trailing ring state."""
+    batches = tuple(tuple(b) if isinstance(b, (tuple, list)) else (b,)
+                    for b in batches)
+    k = len(batches)
+    master = engine.master_flat if engine.zero_flat else engine.master
+    args = (engine.params, master, engine.opt_state,
+            engine.loss_scale_state, engine._stage_hypers_many(k),
+            engine._zero_norm_w, engine._zero_gid_flat,
+            engine._live_flag, batches)
+    spool = getattr(engine, "_spool", None)
+    if spool is not None:
+        args = args + (spool.state,)
+    return args
+
+
+def analyze_engine_train_many(engine, batches) -> Report:
+    """Jaxpr passes over the K-fused ``train_many`` program (K unrolled
+    fused steps feeding each other inside one shard_map) — one trace
+    covers every step's model, collectives and optimizer, so a
+    rank-divergent collective introduced by the unrolling is caught
+    exactly like in the single-step program."""
+    batches = tuple(tuple(b) if isinstance(b, (tuple, list)) else (b,)
+                    for b in batches)
+    rep = Report(subject="train_many")
+    passes.check_shard_specs(dict(engine.mesh.shape),
+                             engine._batch_specs(batches[0]), batches[0],
+                             rep, where="batch")
+    if rep.errors:
+        return rep
+    # the CURRENT cached program only fits if it was built for this
+    # (K, format) pair — otherwise build a matching one (a K=8 program
+    # traced with 2 batches would die on the shard_map arg count)
+    key = (len(batches), engine._batch_cache_key(batches[0]))
+    fn = (engine._train_many_fn if engine._train_many_key == key
+          else engine._cached_batch_fn(
+              engine._train_many_fns, key,
+              lambda: engine._build_train_many(batches[0], len(batches))))
+    rep.extend(analyze_jaxpr(
+        jax.make_jaxpr(fn)(*train_many_args(engine, batches)),
+        mesh_axes=list(engine.mesh.shape.keys()), subject="train_many"))
+    return rep
 
 
 def step_args(engine, grads):

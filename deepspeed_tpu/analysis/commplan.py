@@ -187,12 +187,17 @@ def analyze_comm(jaxpr, mesh_shape: Dict[str, int],
                  profile: Optional[prof_mod.BackendProfile] = None,
                  subject: str = "", multi_host: bool = False) -> CommPlan:
     """Price every collective in ``jaxpr`` (open or closed), multiplying
-    through enclosing scan trip counts.  ``cond``/``switch`` branches take
-    branch 0 — the collective-order lint already guarantees the branches
-    issue matching sequences, so any branch prices the program."""
+    through enclosing scan trip counts.  ``cond``/``switch`` takes the
+    branch with the LARGEST priced wire volume: for rank-uniform conds
+    the collective-order lint already guarantees matching sequences (any
+    branch prices the program), and the multi-step driver's
+    compilation-isolation conds (engine._build_train_many) deliberately
+    pair the real step body with an empty never-taken branch — pricing
+    branch 0 there would report a collective-free training step."""
     costs: List[CollectiveCost] = []
 
-    def visit(j, trips: int, path: str) -> None:
+    def visit(j, trips: int, path: str,
+              out: List[CollectiveCost]) -> None:
         jj = G._as_open_jaxpr(j)
         if jj is None:
             return
@@ -201,7 +206,7 @@ def analyze_comm(jaxpr, mesh_shape: Dict[str, int],
             if name in _PRICED_PRIMS:
                 n = _group_size(eqn, mesh_shape)
                 b = _operand_bytes(eqn)
-                costs.append(CollectiveCost(
+                out.append(CollectiveCost(
                     primitive=name, axes=_axes_of(eqn), group_size=n,
                     executions=trips,
                     bytes_per_execution=_wire_bytes(name, b, n),
@@ -210,18 +215,28 @@ def analyze_comm(jaxpr, mesh_shape: Dict[str, int],
             if not subs:
                 continue
             if name in ("cond", "switch") and len(subs) > 1:
-                label, sub = subs[0]
-                visit(sub, trips, f"{path}/{label}" if path else label)
+                branches = []
+                for label, sub in subs:
+                    branch_costs: List[CollectiveCost] = []
+                    visit(sub, trips,
+                          f"{path}/{label}" if path else label,
+                          branch_costs)
+                    branches.append(branch_costs)
+                out.extend(max(
+                    branches,
+                    key=lambda cs: sum(c.bytes_per_execution
+                                       * c.executions for c in cs)))
             elif name == "scan":
                 length = int(eqn.params.get("length", 1) or 1)
                 for label, sub in subs:
                     visit(sub, trips * length,
-                          f"{path}/{label}" if path else label)
+                          f"{path}/{label}" if path else label, out)
             else:
                 for label, sub in subs:
-                    visit(sub, trips, f"{path}/{label}" if path else label)
+                    visit(sub, trips,
+                          f"{path}/{label}" if path else label, out)
 
-    visit(jaxpr, 1, "")
+    visit(jaxpr, 1, "", costs)
     return CommPlan(subject=subject, costs=costs,
                     mesh_shape=dict(mesh_shape), profile=profile,
                     multi_host=multi_host)

@@ -103,18 +103,32 @@ class FenceModel:
     something drives the timer's ``start()`` — the engine dataloader
     does, a custom loop feeding ``train_batch`` directly does not.
     ``flush_fences`` counts the synchronous spool flush the engine takes
-    at run end / preemption drain."""
+    at run end / preemption drain.
+
+    ``block_steps`` > 1 models the K-fused multi-step driver:
+    ``per_boundary`` fences fire once per K-step BLOCK (the engine reads
+    the whole ``[K]`` skip vector in one fence at the block edge), so
+    over N steps the count is ``N // K`` blocks' worth — the K×
+    amortization this PR exists for.  The reporter never fences at
+    K > 1 (``train_many`` always passes ``sync_on=None``)."""
 
     per_boundary: int = 0
     tput_report: bool = False
     steps_per_output: int = 0
     start_step: int = 2
     flush_fences: int = 0       # per flush_telemetry() call, not per step
+    block_steps: int = 1        # boundaries fused per dispatch (K)
 
     def count(self, n_steps: int, prior_boundaries: int = 0,
               flushes: int = 0) -> int:
         """Predicted fence-counter delta over ``n_steps`` boundaries
-        starting after ``prior_boundaries`` completed ones."""
+        starting after ``prior_boundaries`` completed ones.  With
+        ``block_steps`` > 1, ``n_steps`` should cover whole blocks (the
+        engine only ever completes whole dispatches); a ragged remainder
+        is floored — fences fire at block EDGES only."""
+        if self.block_steps > 1:
+            total = (n_steps // self.block_steps) * self.per_boundary
+            return total + flushes * self.flush_fences
         total = n_steps * self.per_boundary
         if self.tput_report and self.steps_per_output > 0:
             for b in range(prior_boundaries + 1,
@@ -126,9 +140,10 @@ class FenceModel:
 
     def per_step_steady(self) -> float:
         """Average fences per boundary at steady state (report cadence
-        amortized)."""
-        rate = float(self.per_boundary)
-        if self.tput_report and self.steps_per_output > 0:
+        and K-block amortization folded in)."""
+        rate = float(self.per_boundary) / max(1, self.block_steps)
+        if self.block_steps <= 1 and self.tput_report \
+                and self.steps_per_output > 0:
             rate += 1.0 / self.steps_per_output
         return rate
 
@@ -242,6 +257,7 @@ class DispatchPlan:
                 "steps_per_output": self.fence_model.steps_per_output,
                 "start_step": self.fence_model.start_step,
                 "flush_fences": self.fence_model.flush_fences,
+                "block_steps": self.fence_model.block_steps,
             },
         }
         if self.executables is not None:
@@ -266,7 +282,8 @@ def _n_leaves(args) -> int:
 # ------------------------------------------------------------- engine plans
 
 def plan_engine_dispatch(engine, batch, fused: bool = True,
-                         profile: Optional[prof_mod.BackendProfile] = None
+                         profile: Optional[prof_mod.BackendProfile] = None,
+                         steps_per_dispatch: Optional[int] = None
                          ) -> DispatchPlan:
     """Static host timeline of one optimizer step for ``batch``'s format.
 
@@ -279,7 +296,13 @@ def plan_engine_dispatch(engine, batch, fused: bool = True,
     batch for ``fused=True`` (what ``train_batch()`` takes — one staging
     per step) and ONE MICRO batch for ``fused=False`` (what ``forward()``
     takes — ``gas`` stagings per step), which is exactly what the
-    engine's build-time gate passes from each path."""
+    engine's build-time gate passes from each path.
+
+    ``steps_per_dispatch`` (default: the engine's configured K) > 1
+    prices the fused multi-step driver: ONE ``train_many`` dispatch per
+    K optimizer steps, the skip-contract fence once per BLOCK, and the
+    reporter fence gone — the amortization the contract test verifies
+    against the runtime counters."""
     from deepspeed_tpu import analysis
     from deepspeed_tpu.analysis import stability
 
@@ -297,11 +320,32 @@ def plan_engine_dispatch(engine, batch, fused: bool = True,
     has_writer = engine.summary_writer is not None
     has_sched = engine.lr_scheduler is not None
     n_groups = len(engine._group_defs)
+    if steps_per_dispatch is None:
+        steps_per_dispatch = int(getattr(engine, "steps_per_dispatch", 1))
+    k = steps_per_dispatch if fused else 1
 
     events: List[DispatchEvent] = []
     per_boundary_fences = 0
 
-    if fused:
+    if fused and k > 1:
+        # leaf count WITHOUT marshalling the real train_many tuple:
+        # train_many_args stages the [K,4,G] hyper block (and, with a
+        # scheduler, steps/restores it k-1 times) — device/scheduler
+        # side effects a static pass must not take.  vs the fused
+        # single-step tuple: same state/hyper leaves, +1 live flag,
+        # +(k-1) extra batch trees.
+        base = analysis.train_batch_args(engine, batch)
+        n_leaves = (_n_leaves(base) + 1
+                    + (k - 1) * len(jax.tree_util.tree_leaves(batch)))
+        events.append(DispatchEvent(
+            "dispatch", "train_many", 1.0 / k, n_leaves=n_leaves,
+            note=f"K={k} fused optimizer steps in ONE program — the "
+                 f"per-step dispatch amortized K×"))
+        events.append(DispatchEvent(
+            "transfer", "batch", 1.0, bytes_per=_tree_bytes(batch),
+            note=f"K effective batches staged per dispatch (one per "
+                 f"step; one staging CALL per {k} steps)"))
+    elif fused:
         args = analysis.train_batch_args(engine, batch)
         events.append(DispatchEvent(
             "dispatch", "train_batch", 1.0, n_leaves=_n_leaves(args),
@@ -346,22 +390,28 @@ def plan_engine_dispatch(engine, batch, fused: bool = True,
                      "backward_reduce every micro step"))
 
     # hyper staging: ONE cached [4, G] device array; re-staged only when a
-    # scheduler moved a value (engine._current_hypers)
+    # scheduler moved a value (engine._current_hypers).  The K-fused
+    # driver stages the [K, 4, G] block once per dispatch instead.
     events.append(DispatchEvent(
-        "transfer", "hypers", 1.0 if has_sched else 0.0,
-        bytes_per=16 * max(1, n_groups),
-        note="[4, G] stacked hypers; 0 transfers when no scheduler moves "
-             "the values"))
+        "transfer", "hypers", (1.0 / k if has_sched else 0.0),
+        bytes_per=16 * max(1, n_groups) * k,
+        note=("[K, 4, G] prospective rows staged per dispatch"
+              if k > 1 else
+              "[4, G] stacked hypers; 0 transfers when no scheduler "
+              "moves the values")))
 
     if skip_contract and not deferred:
         per_boundary_fences += 1
         events.append(DispatchEvent(
-            "fence", "overflow-read", 1.0,
-            note="fp16/nan-sentinel skip contract host read"
+            "fence", "overflow-read", 1.0 / k,
+            note=("fp16/nan-sentinel skip contract host read"
+                  if k == 1 else
+                  f"skip-contract [K] vector read once per {k}-step "
+                  f"block (the per-step fence amortized K×)")
                  + (" (retained: LR scheduler gates on it — the "
                     "documented exception)" if spool is not None else
-                    "; deferred to the window drain when the spool is on"
-                    )))
+                    ("; deferred to the window drain when the spool is "
+                     "on" if k == 1 else ""))))
 
     flush_fences = 0
     if spool is not None:
@@ -388,14 +438,17 @@ def plan_engine_dispatch(engine, batch, fused: bool = True,
     timer_driven = (getattr(engine, "training_dataloader", None) is not None
                     or bool(getattr(engine.tput_timer, "initialized",
                                     False)))
-    tput_report = spool is None and timer_driven
+    # train_many always stops the reporter with sync_on=None (goodput
+    # rides the telemetry windows at K > 1) — no report fence
+    tput_report = spool is None and timer_driven and k == 1
     fence_model = FenceModel(
         per_boundary=per_boundary_fences,
         tput_report=tput_report,
         steps_per_output=int(getattr(engine.tput_timer, "steps_per_output",
                                      0) or 0),
         start_step=int(getattr(engine.tput_timer, "start_step", 2)),
-        flush_fences=flush_fences)
+        flush_fences=flush_fences,
+        block_steps=k)
     if tput_report and fence_model.steps_per_output > 0:
         events.append(DispatchEvent(
             "fence", "tput-report",
@@ -403,9 +456,11 @@ def plan_engine_dispatch(engine, batch, fused: bool = True,
             note="throughput reporter fences on report boundaries only "
                  "(PR 1 window accounting)"))
 
-    kind = "train_batch" if fused else "fwdbwd+step"
+    kind = ("train_many" if fused and k > 1
+            else "train_batch" if fused else "fwdbwd+step")
     pred = stability.predict_executables(engine, [batch], train=True,
-                                         fused=fused)
+                                         fused=fused,
+                                         steps_per_dispatch=k)
     return DispatchPlan(subject=kind, events=events,
                         fence_model=fence_model, profile=profile,
                         executables=pred)
@@ -445,23 +500,50 @@ def plan_serve_dispatch(engine,
         fence_model=FenceModel(per_boundary=1),
         profile=profile, executables=pred)
 
-    decode = DispatchPlan(
-        subject="decode",
-        events=[
-            DispatchEvent("dispatch", "decode", 1.0,
-                          n_leaves=_n_leaves(
-                              engine._program_args("decode")),
-                          note="one token step across ALL slots"),
-            DispatchEvent("transfer", "tokens+active", 1.0,
-                          bytes_per=5 * slots,
-                          note="per-slot input token + active mask"),
-            DispatchEvent("fence", "logits-read", 1.0,
-                          bytes_per=4 * vocab * slots, removable=False,
-                          note="sampler data dependency, every "
-                               "iteration"),
-        ],
-        fence_model=FenceModel(per_boundary=1),
-        profile=profile, executables=pred)
+    d = int(getattr(engine, "decode_iters_per_dispatch", 1))
+    if d > 1:
+        # D-fused decode: one dispatch + one TOKEN read (not logits —
+        # the sampler ran on device) per D iterations
+        decode = DispatchPlan(
+            subject="decode",
+            events=[
+                DispatchEvent("dispatch", "decode_many", 1.0 / d,
+                              n_leaves=_n_leaves(
+                                  engine._program_args("decode_many")),
+                              note=f"D={d} token steps fused into ONE "
+                                   f"dispatch (greedy closes on device)"),
+                DispatchEvent("transfer", "tokens+masks", 1.0 / d,
+                              bytes_per=13 * slots,
+                              note="per-slot token + active/eos/budget "
+                                   "vectors, once per D-block"),
+                DispatchEvent("fence", "tokens-read", 1.0 / d,
+                              bytes_per=5 * slots * d, removable=False,
+                              note=f"[D, slots] tokens + emitted masks "
+                                   f"once per {d} iterations — the "
+                                   f"per-token logits fence amortized "
+                                   f"D× (and vocab× smaller)"),
+            ],
+            fence_model=FenceModel(per_boundary=1, block_steps=d),
+            profile=profile, executables=pred)
+    else:
+        decode = DispatchPlan(
+            subject="decode",
+            events=[
+                DispatchEvent("dispatch", "decode", 1.0,
+                              n_leaves=_n_leaves(
+                                  engine._program_args("decode")),
+                              note="one token step across ALL slots"),
+                DispatchEvent("transfer", "tokens+active", 1.0,
+                              bytes_per=5 * slots,
+                              note="per-slot input token + active mask"),
+                DispatchEvent("fence", "logits-read", 1.0,
+                              bytes_per=4 * vocab * slots,
+                              removable=False,
+                              note="sampler data dependency, every "
+                                   "iteration"),
+            ],
+            fence_model=FenceModel(per_boundary=1),
+            profile=profile, executables=pred)
     return {"prefill": prefill, "decode": decode}
 
 

@@ -574,11 +574,19 @@ _TRAIN_BATCH_LABELS = ("params", "master", "opt_state", "loss_scale",
                        "hypers", "zero_norm_w", "zero_gid", "batch",
                        "spool")
 
+#: K-fused call protocol (analysis.train_many_args): the hyper slot is
+#: the [K, 4, G] block, "live" the cond predicate input, "batch" the
+#: tuple of K per-step batch trees
+_TRAIN_MANY_LABELS = ("params", "master", "opt_state", "loss_scale",
+                      "hypers", "zero_norm_w", "zero_gid", "live",
+                      "batch", "spool")
+
 
 def plan_engine(engine, batch, train: bool = True,
                 profile: Optional[prof_mod.BackendProfile] = None,
                 budget_bytes: Optional[int] = None, fused: bool = True,
-                with_comm: bool = True) -> CapacityPlan:
+                with_comm: bool = True,
+                steps_per_dispatch: Optional[int] = None) -> CapacityPlan:
     """Full capacity plan for one engine + batch format.
 
     ``fused=True`` plans the fused ``train_batch`` program (the
@@ -586,6 +594,12 @@ def plan_engine(engine, batch, train: bool = True,
     one trace); ``fused=False`` plans the split-API pair (``fwdbwd`` per
     micro-batch + the ``step`` boundary program), whose step-only
     :class:`~.commplan.CommPlan` is the predicted *boundary* wire time.
+    ``steps_per_dispatch`` (default: the engine's configured K) > 1
+    plans the ACTUAL K-fused ``train_many`` program — which holds K full
+    effective batches as simultaneous inputs, so pricing the single-step
+    program would under-count ~(K-1) batch copies of residency and let
+    an over-HBM K config through the error gate.  (Its CommPlan prices
+    one DISPATCH = K optimizer steps.)
     ``budget_bytes=None`` = report-only (``memory.no-budget``); callers
     gating against a profile pass ``profile.hbm_bytes`` themselves (the
     engine/CLI do, for *explicitly chosen* profiles — the
@@ -597,13 +611,34 @@ def plan_engine(engine, batch, train: bool = True,
     batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
     if profile is None:
         profile = prof_mod.default_profile()
+    if steps_per_dispatch is None:
+        steps_per_dispatch = int(getattr(engine, "steps_per_dispatch", 1))
+    k = steps_per_dispatch if (train and fused) else 1
     mesh_shape = dict(engine.mesh.shape)
     multi_host = jax.process_count() > 1
 
     programs = []
     comm = None
     boundary_comm = None
-    if train and fused:
+    if train and fused and k > 1:
+        from deepspeed_tpu import analysis as _analysis
+        key = (k, engine._batch_cache_key(batch))
+        fn = engine._cached_batch_fn(
+            engine._train_many_fns, key,
+            lambda: engine._build_train_many(batch, k))
+        args = _analysis.train_many_args(
+            engine, tuple(batch for _ in range(k)))
+        donate = engine._donate_argnums(fused=True)
+        closed = jax.make_jaxpr(fn)(*args)
+        programs.append(analyze_program(
+            fn, args, donate_argnums=donate,
+            arg_labels=_TRAIN_MANY_LABELS, subject="train_many",
+            profile=profile, closed=closed))
+        if with_comm:
+            comm = commplan.analyze_comm(
+                closed, mesh_shape, profile=profile,
+                subject="train_many", multi_host=multi_host)
+    elif train and fused:
         key = engine._batch_cache_key(batch)
         fn = engine._cached_batch_fn(
             engine._train_batch_fns, key,

@@ -105,10 +105,16 @@ PROFILES: Dict[str, BackendProfile] = {
         name="cpu-8", hbm_gib=4.0, ici_gibps=10.0, dcn_gibps=10.0,
         peak_bf16_tflops=1.0, lowp_dot_f32_copies=True,
         persistent_cache_donation_unsafe=True,
-        # host == device: no PCIe hop, no device round trip — the
-        # BENCH_DISPATCH cpu rows calibrate these
-        dispatch_us=60.0, dispatch_leaf_us=1.0, fence_us=30.0,
-        callback_us=200.0, h2d_gibps=8.0),
+        # host == device: no PCIe hop, no device round trip.
+        # CALIBRATED from this rig's bench_dispatch.json measured
+        # columns (dispatch 3.657 µs, per-leaf 1.835 µs, fence 0.071 µs,
+        # h2d 1.068 GiB/s — the old nominal guesses were 16×/420× off
+        # and made every cpu dispatch-cost prediction fiction).
+        # callback_us stays nominal: the microbench has no io_callback
+        # leg yet.  Re-measure: BENCH_DISPATCH=1 python bench.py — the
+        # leg now WARNS when measured/predicted drifts past 4×.
+        dispatch_us=4.0, dispatch_leaf_us=1.8, fence_us=0.1,
+        callback_us=200.0, h2d_gibps=1.0),
 }
 
 #: axes that cross DCN when the mesh spans hosts (docs/scaling.md: data

@@ -332,14 +332,21 @@ def _format_label(i: int) -> str:
 
 
 def predict_executables(engine, batches: Sequence, train: bool = True,
-                        fused: bool = True) -> ExecutablePrediction:
+                        fused: bool = True,
+                        steps_per_dispatch: Optional[int] = None
+                        ) -> ExecutablePrediction:
     """Executable count the engine builds for ``batches`` (a sequence of
     example batches; distinct FORMATS — pytree structure + leaf
     shapes/dtypes — are deduped exactly like the engine's own program
     caches, the PR 1 fix made checkable).  Exactly ONE executable per
     (program kind, format); the split API adds the format-independent
     ``step`` program, and an active metric spool adds its drain (and, on
-    the split API, append) program."""
+    the split API, append) program.  ``steps_per_dispatch`` > 1 models
+    the K-fused driver: ``train_many`` replaces ``train_batch`` (still
+    one executable per format — K is part of the program, not the
+    format)."""
+    if steps_per_dispatch is None:
+        steps_per_dispatch = int(getattr(engine, "steps_per_dispatch", 1))
     keys = []
     for b in batches:
         b = tuple(b) if isinstance(b, (tuple, list)) else (b,)
@@ -348,8 +355,9 @@ def predict_executables(engine, batches: Sequence, train: bool = True,
             keys.append(k)
     programs: List[Tuple[str, str, int]] = []
     if train and fused:
+        kind = ("train_many" if steps_per_dispatch > 1 else "train_batch")
         for i, _ in enumerate(keys):
-            programs.append(("train_batch", _format_label(i), 1))
+            programs.append((kind, _format_label(i), 1))
     elif train:
         for i, _ in enumerate(keys):
             programs.append(("fwdbwd", _format_label(i), 1))
@@ -370,9 +378,14 @@ def predict_executables(engine, batches: Sequence, train: bool = True,
 def predict_executables_serve(engine) -> ExecutablePrediction:
     """The inference engine's promise, as a number: exactly TWO
     executables (prefill + decode) regardless of prompt lengths, request
-    counts or scheduler decisions."""
+    counts or scheduler decisions.  With
+    ``inference.decode_iters_per_dispatch`` > 1 the decode executable is
+    the D-fused ``decode_many`` — still two."""
+    decode = ("decode_many"
+              if int(getattr(engine, "decode_iters_per_dispatch", 1)) > 1
+              else "decode")
     return ExecutablePrediction(subject="serve", programs=[
-        ("prefill", "bucket", 1), ("decode", "slots", 1)])
+        ("prefill", "bucket", 1), (decode, "slots", 1)])
 
 
 # ----------------------------------------------------------- engine surface

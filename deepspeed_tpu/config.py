@@ -31,6 +31,34 @@ class DeepSpeedConfigError(Exception):
     pass
 
 
+def _fused_count(value, key_name: str, env_name: str) -> int:
+    """Resolve a fused-dispatch count key (``train_steps_per_dispatch``
+    K / ``inference.decode_iters_per_dispatch`` D) with its env escape
+    hatch — ONE owner of the override policy so the two knobs cannot
+    drift: ``off``/``false``/``0`` force 1, an integer overrides, and
+    the resolved count must be >= 1."""
+    env = os.environ.get(env_name, "").strip().lower()
+    if env in ("off", "false", "0"):
+        value = 1
+    elif env:
+        try:
+            value = int(env)
+        except ValueError:
+            raise DeepSpeedConfigError(
+                f"{env_name}={env!r} is not a count: use 'off' or an "
+                f"integer >= 1")
+    try:
+        value = int(value)
+    except (TypeError, ValueError):
+        raise DeepSpeedConfigError(
+            f"{key_name} must be an integer >= 1, got {value!r}")
+    if value < 1:
+        raise DeepSpeedConfigError(
+            f"{key_name} must be >= 1 (1 = the unfused per-step path), "
+            f"got {value}")
+    return value
+
+
 class FP16Params:
     """fp16 section (reference deepspeed_constants.py:84-118)."""
 
@@ -103,6 +131,15 @@ class DeepSpeedConfig:
         self.steps_per_print = get_scalar_param(
             pd, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
         self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+
+        # on-device multi-step driver: K optimizer steps fused into ONE
+        # compiled dispatch (engine.train_many; docs/features.md
+        # "Multi-step driver").  DSTPU_MULTISTEP is the env escape hatch:
+        # "off"/"0" force the per-step path, an integer overrides K.
+        self.train_steps_per_dispatch = _fused_count(
+            get_scalar_param(pd, C.TRAIN_STEPS_PER_DISPATCH,
+                             C.TRAIN_STEPS_PER_DISPATCH_DEFAULT),
+            C.TRAIN_STEPS_PER_DISPATCH, "DSTPU_MULTISTEP")
 
         self.disable_allgather = get_scalar_param(
             pd, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
@@ -644,7 +681,8 @@ class DeepSpeedConfig:
         inf_known = {C.INFERENCE_MAX_SLOTS, C.INFERENCE_MAX_TOKENS,
                      C.INFERENCE_PREFILL_BUCKET, C.INFERENCE_KV_LAYOUT,
                      C.INFERENCE_PAGE_TOKENS, C.INFERENCE_DTYPE,
-                     C.INFERENCE_QUANTIZE}
+                     C.INFERENCE_QUANTIZE,
+                     C.INFERENCE_DECODE_ITERS_PER_DISPATCH}
         if inf is not None and set(inf) - inf_known:
             # a typo'd serving knob would silently serve with defaults —
             # loud, like the resilience section
@@ -702,6 +740,15 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"{C.INFERENCE}.{C.INFERENCE_QUANTIZE} must be null or "
                 f"'int8', got {self.inference_quantize!r}")
+        # fused decode: D iterations per compiled dispatch (the serving
+        # analog of train_steps_per_dispatch; docs/inference.md "Fused
+        # decode").  DSTPU_DECODE_ITERS overrides, same policy as
+        # DSTPU_MULTISTEP (_fused_count is the one owner).
+        self.inference_decode_iters_per_dispatch = _fused_count(
+            get_scalar_param(inf, C.INFERENCE_DECODE_ITERS_PER_DISPATCH,
+                             C.INFERENCE_DECODE_ITERS_PER_DISPATCH_DEFAULT),
+            f"{C.INFERENCE}.{C.INFERENCE_DECODE_ITERS_PER_DISPATCH}",
+            "DSTPU_DECODE_ITERS")
 
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)
@@ -813,6 +860,23 @@ class DeepSpeedConfig:
                 "DeepSpeedConfig: sparse_gradients_max_rows must be > 0 "
                 f"(got {self.sparse_gradients_max_rows}); a non-positive "
                 "bound would silently force the dense fallback every step")
+        if (self.train_steps_per_dispatch > 1
+                and self.observability_report_window >= 1
+                and self.observability_report_window
+                % self.train_steps_per_dispatch != 0):
+            # the spool ring drains on window edges; a K-fused dispatch
+            # appends K rows at once, so a window that is not a multiple
+            # of K would cross an edge MID-dispatch and overrun the ring
+            # before the drain can run (docs/observability.md "Window
+            # alignment")
+            raise DeepSpeedConfigError(
+                f"DeepSpeedConfig: {C.OBSERVABILITY}."
+                f"{C.OBSERVABILITY_REPORT_WINDOW} "
+                f"({self.observability_report_window}) must be a multiple "
+                f"of {C.TRAIN_STEPS_PER_DISPATCH} "
+                f"({self.train_steps_per_dispatch}): the metric spool "
+                f"drains on window edges and a K-fused dispatch appends K "
+                f"rows per call")
 
     def _do_warning_check(self):
         """Reference deepspeed_config.py:395-421."""

@@ -54,6 +54,16 @@ ZERO_SUPPORTED_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER]
 STEPS_PER_PRINT = "steps_per_print"
 STEPS_PER_PRINT_DEFAULT = 10
 
+# K optimizer steps fused into ONE compiled dispatch
+# (engine.train_many — the on-device multi-step driver,
+# docs/features.md "Multi-step driver").  1 = the per-step train_batch
+# path.  Env escape hatch DSTPU_MULTISTEP overrides ("off"/"1"
+# disables, an integer sets K).  With the metric spool on,
+# observability.report_window must be a multiple of K (window drains
+# align with K-block edges; enforced at config time).
+TRAIN_STEPS_PER_DISPATCH = "train_steps_per_dispatch"
+TRAIN_STEPS_PER_DISPATCH_DEFAULT = 1
+
 #############################################
 # Training options
 #############################################
@@ -304,6 +314,12 @@ INFERENCE_DTYPE_DEFAULT = "bfloat16"
 # matmul-dequant dispatch table — inference/quant.py)
 INFERENCE_QUANTIZE = "quantize"
 INFERENCE_QUANTIZE_DEFAULT = None
+# D decode iterations fused into ONE compiled dispatch (greedy sampling
+# on-device; admission/eviction every D tokens — docs/inference.md
+# "Fused decode").  1 = the per-iteration path.  Env escape hatch
+# DSTPU_DECODE_ITERS overrides ("off"/"1" disables, an integer sets D).
+INFERENCE_DECODE_ITERS_PER_DISPATCH = "decode_iters_per_dispatch"
+INFERENCE_DECODE_ITERS_PER_DISPATCH_DEFAULT = 1
 
 #############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming

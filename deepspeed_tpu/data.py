@@ -37,6 +37,52 @@ def default_collate(samples):
                                   *samples[1:])
 
 
+def _iter_prefetched(items: Iterator[Any], depth: int, name: str):
+    """Producer-thread prefetch: drain ``items`` on a daemon thread,
+    keeping up to ``depth`` of them ready for the consumer (the torch
+    DataLoader worker analog) — the ONE owner of the queue/sentinel/
+    exception-forwarding machinery ``DeepSpeedDataLoader`` (per batch)
+    and ``BlockPrefetcher`` (per K-block) share.  Abandoning the
+    returned iterator early (break / GC) signals the producer to exit
+    instead of leaving it blocked on a full queue; a producer exception
+    re-raises in the consumer."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    stop = threading.Event()
+    SENTINEL = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for item in items:
+                if not put(item):
+                    return
+            put(SENTINEL)
+        except BaseException as e:  # surface in the consumer
+            put(e)
+
+    t = threading.Thread(target=produce, daemon=True, name=name)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        t.join()
+
+
 class DeepSpeedDataLoader:
     """Sharded batch iterator.
 
@@ -190,52 +236,19 @@ class DeepSpeedDataLoader:
 
     def _prefetched(self, idx: np.ndarray, start: int = 0):
         """Producer thread keeps up to ``prefetch_depth`` collated batches
-        ready while the device computes (torch DataLoader worker analog).
-        Abandoning the iterator early (break / GC) signals the producer to
-        exit instead of leaving it blocked on a full queue."""
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
-        stop = threading.Event()
-        SENTINEL = object()
+        ready while the device computes (see :func:`_iter_prefetched`)."""
+        def produced():
+            for batch in self._batches(idx, start):
+                # device placement on the producer: jax.device_put is
+                # async (returns after enqueueing the DMA), so with
+                # queue depth >= 2 the NEXT batch's host->device copy
+                # overlaps the current step's compute — double
+                # buffering (VERDICT r4 weak #4)
+                yield (self._place(batch) if self.device_prefetch
+                       else batch)
 
-        def put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def produce():
-            try:
-                for batch in self._batches(idx, start):
-                    # device placement on the producer: jax.device_put is
-                    # async (returns after enqueueing the DMA), so with
-                    # queue depth >= 2 the NEXT batch's host->device copy
-                    # overlaps the current step's compute — double
-                    # buffering (VERDICT r4 weak #4)
-                    if self.device_prefetch:
-                        batch = self._place(batch)
-                    if not put(batch):
-                        return
-                put(SENTINEL)
-            except BaseException as e:  # surface in the consumer
-                put(e)
-
-        t = threading.Thread(target=produce, daemon=True,
-                             name="dstpu-io-prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is SENTINEL:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-            t.join()
+        return _iter_prefetched(produced(), self.prefetch_depth,
+                                "dstpu-io-prefetch")
 
     def __iter__(self) -> Iterator[Any]:
         idx = self._indices()
@@ -267,6 +280,63 @@ class DeepSpeedDataLoader:
                 yield self._place(batch)
         self.epoch += 1
         self._batch_pos = 0
+
+
+class BlockPrefetcher:
+    """Group a batch iterator into K-blocks for ``engine.train_many``,
+    staging block i+1 on a producer thread while block i trains — the
+    host side of the on-device multi-step driver (docs/features.md
+    "Multi-step driver").
+
+    Each yielded block is a LIST of K batches (the ``train_many``
+    argument shape: separate per-step trees, not a stacked array — see
+    ``engine._build_train_many`` for why stacking would break the
+    bitwise parity contract).  With ``place`` given (e.g. a bound
+    ``loader._place``) every batch is staged to device ON THE PRODUCER:
+    ``device_put`` is async, so with ``depth >= 2`` the next block's K
+    host→device copies overlap the current block's K fused steps —
+    double buffering at block granularity.
+
+    A trailing partial block (fewer than K batches left) is yielded
+    as-is by default; ``drop_last=True`` discards it (a partial block
+    compiles one extra K'-step program)."""
+
+    def __init__(self, batch_iter, k: int, place: Optional[Callable] = None,
+                 depth: int = 2, drop_last: bool = False):
+        if k < 1:
+            raise ValueError(f"BlockPrefetcher: k must be >= 1, got {k}")
+        self.batch_iter = iter(batch_iter)
+        self.k = int(k)
+        self.place = place
+        self.depth = max(1, int(depth))
+        self.drop_last = bool(drop_last)
+        self._consumed = False
+
+    def _blocks(self):
+        block = []
+        for batch in self.batch_iter:
+            if self.place is not None:
+                batch = self.place(batch)
+            block.append(batch)
+            if len(block) == self.k:
+                yield block
+                block = []
+        if block and not self.drop_last:
+            yield block
+
+    def __iter__(self) -> Iterator[list]:
+        # one-shot: the upstream iterator is consumed by the producer
+        # thread; a second iteration would race a fresh producer against
+        # any still-draining first one over the same iterator — fail
+        # loudly instead of yielding nondeterministic block membership
+        if self._consumed:
+            raise RuntimeError(
+                "BlockPrefetcher is one-shot: its upstream batch "
+                "iterator is already (being) consumed — construct a new "
+                "prefetcher over a fresh iterator")
+        self._consumed = True
+        return _iter_prefetched(self._blocks(), self.depth,
+                                "dstpu-block-prefetch")
 
 
 class FileDataset:

@@ -780,6 +780,21 @@ class DeepSpeedTpuEngine:
         self._train_batch_fn = None
         self._train_batch_key = None
         self._train_batch_fns = {}
+        # multi-step driver (train_many): K fused optimizer steps per
+        # dispatch.  Programs key on (K, batch format); the staged
+        # [K, 4, G] hyper block caches on its host rows like
+        # _current_hypers.
+        self.steps_per_dispatch = int(self.config.train_steps_per_dispatch)
+        self._train_many_fn = None
+        self._train_many_key = None
+        self._train_many_fns = {}
+        self._hyper_many_key = None
+        self._hyper_many_dev = None
+        # runtime-true predicate input of the per-step cond isolation in
+        # train_many (see _build_train_many) — pinned committed+replicated
+        # at build like the loss-scale leaves (stability.unpinned-sharding)
+        self._live_flag = jax.device_put(jnp.ones((), jnp.int32),
+                                         self._named(P()))
         self._loss_treedefs = {}    # loss pytree structure per batch key
         self._acc = None            # accumulated local grads ([dp, ...] tree)
         self._cached_grads = None   # grads from the last forward
@@ -1178,13 +1193,15 @@ class DeepSpeedTpuEngine:
         """reference deepspeed_light.py:698-706"""
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
-    def _armed(self, label):
+    def _armed(self, label, deadline_scale: float = 1.0):
         """Watchdog-armed context for a blocking call (nullcontext when the
-        resilience watchdog is off — docs/resilience.md)."""
+        resilience watchdog is off — docs/resilience.md).
+        ``deadline_scale`` stretches the deadline for regions that cover
+        several optimizer steps (the K-fused ``train_many`` dispatch)."""
         if self._watchdog is None:
             from contextlib import nullcontext
             return nullcontext()
-        return self._watchdog.armed(label)
+        return self._watchdog.armed(label, deadline_scale=deadline_scale)
 
     def resilience_counters(self) -> dict:
         """Process-wide resilience counters (restarts, skipped-NaN steps,
@@ -1559,14 +1576,18 @@ class DeepSpeedTpuEngine:
         return rep.filtered(self._graph_lint_suppress)
 
     def plan_capacity(self, batch, train: bool = True, fused: bool = True,
-                      profile=None, budget_gb=None):
+                      profile=None, budget_gb=None,
+                      steps_per_dispatch=None):
         """Static capacity plan (per-device peak HBM + bytes on wire) for
         ``batch``'s format — :class:`deepspeed_tpu.analysis.CapacityPlan`.
         No compile, no execution: the programs are traced abstractly.
         ``profile``/``budget_gb`` default to the config ``analysis``
         section; an unset budget falls back to the explicitly chosen
         profile's HBM, and with neither set the plan is report-only (the
-        running backend's profile still shapes the memory model)."""
+        running backend's profile still shapes the memory model).
+        ``steps_per_dispatch`` defaults to the configured K: a K>1
+        engine's fused plan prices the ACTUAL K-fused ``train_many``
+        program (K staged batches of residency, not one)."""
         from deepspeed_tpu.analysis import memplan, profiles
         batch = _as_tuple(batch)
         if profile is None and self.config.analysis_profile:
@@ -1584,7 +1605,8 @@ class DeepSpeedTpuEngine:
             budget_bytes = profile.hbm_bytes
         return memplan.plan_engine(self, batch, train=train, fused=fused,
                                    profile=profile,
-                                   budget_bytes=budget_bytes)
+                                   budget_bytes=budget_bytes,
+                                   steps_per_dispatch=steps_per_dispatch)
 
     def run_stability(self, batch, train: bool = True, fused: bool = True):
         """Compile-stability report for ``batch``'s format
@@ -1653,7 +1675,8 @@ class DeepSpeedTpuEngine:
         return ((1, 2, 3) if self.policy.compute_dtype == jnp.float32
                 else (0, 1, 2, 3))
 
-    def _maybe_capacity_plan(self, kind, key, run, batch=None):
+    def _maybe_capacity_plan(self, kind, key, run, batch=None,
+                             steps_per_dispatch=1):
         """Run the capacity planner once per (program kind, batch format)
         and dispatch per ``analysis.mode`` through the same
         :func:`~deepspeed_tpu.analysis.dispatch_report` gate as graph
@@ -1664,14 +1687,18 @@ class DeepSpeedTpuEngine:
         compile-stability and dispatch-cost passes ride the same gate:
         their ``stability.*`` / ``dispatch.*`` findings join the report
         tree (same mode/suppress machinery, docs/analysis.md "Dispatch &
-        compile-stability")."""
+        compile-stability").  ``steps_per_dispatch`` is the GATED
+        program's actual K (1 for the ``train_batch`` path even on a
+        K-configured engine, the real block size for ``train_many``) —
+        the ride-along dispatch plan must price the program being built,
+        not the config's intent."""
         mode = self._analysis_mode
         if mode == "off" or (kind, key) in self._planned_keys:
             return
         self._planned_keys.add((kind, key))
         try:
             plan = run()
-            if kind == "train_batch":
+            if kind in ("train_batch", "train_many"):
                 # planner handoff: the telemetry drift columns reuse THIS
                 # plan instead of re-tracing the fused program
                 self._telemetry.note_fused_plan(plan)
@@ -1685,12 +1712,13 @@ class DeepSpeedTpuEngine:
                 from deepspeed_tpu.analysis import dispatchplan
                 from deepspeed_tpu.analysis import stability as stab
                 train = kind != "eval"
-                fused = kind == "train_batch"
+                fused = kind in ("train_batch", "train_many")
                 rep.extend(stab.check_engine(self, batch, fused=fused,
                                              train=train))
                 if train:
                     dplan = dispatchplan.plan_engine_dispatch(
-                        self, batch, fused=fused, profile=plan.profile)
+                        self, batch, fused=fused, profile=plan.profile,
+                        steps_per_dispatch=steps_per_dispatch)
                     rep.extend(dplan.to_report())
             except Exception as e:  # pragma: no cover - defensive
                 logger.warning("stability/dispatch analysis could not "
@@ -2469,12 +2497,7 @@ class DeepSpeedTpuEngine:
         ``per_step_fixed_lamb_dispatch``) collapse to one transfer when a
         scheduler moved a value and ZERO when none did (constant-LR runs,
         and every run's beta/wd rows)."""
-        base = self.base_optimizer
-        groups = self.optimizer.param_groups
-        betas = [g.get("betas", (base.beta1, base.beta2)) for g in groups]
-        key = tuple((float(g["lr"]), float(b[0]), float(b[1]),
-                     float(g.get("weight_decay", base.weight_decay)))
-                    for g, b in zip(groups, betas))
+        key = self._hyper_rows_host()
         if key != self._hyper_key:
             rows = np.asarray(
                 [[k[0] for k in key], [k[1] for k in key],
@@ -2561,13 +2584,14 @@ class DeepSpeedTpuEngine:
 
     # --------------------------------------------------------- fused hot path
 
-    def _build_train_batch(self, batch):
-        """ONE jitted XLA program for the full effective batch: ``lax.scan``
-        over gas micro-steps (fwd+bwd, grads accumulated on device) feeding
-        straight into the boundary update — grads never leave the device and
-        there is a single dispatch per optimizer step (the reference needs
-        gas+1 host round-trips, deepspeed_light.py:603-807; the split API
-        here needed gas fwd dispatches + an accumulate + a step dispatch)."""
+    def _make_fused_local(self):
+        """The per-optimizer-step fused body (gas micro-steps scanned into
+        the boundary update) that runs INSIDE shard_map — shared by
+        ``_build_train_batch`` (one step per dispatch) and
+        ``_build_train_many`` (K steps unrolled per dispatch).  Returns
+        ``f(params, master, opt_state, ls_state, hypers, normw, gids,
+        batch_args) -> (params, master, opt_state, ls_state, overflow,
+        total_norm, last_loss)``."""
         gas = self.gradient_accumulation_steps()
         loss_and_grads = self._make_loss_and_grads()
         step_local = self._make_step_local()
@@ -2621,6 +2645,16 @@ class DeepSpeedTpuEngine:
             return (params_new, master_new, opt_new, ls_new, overflow,
                     total_norm, last_loss)
 
+        return local
+
+    def _build_train_batch(self, batch):
+        """ONE jitted XLA program for the full effective batch: ``lax.scan``
+        over gas micro-steps (fwd+bwd, grads accumulated on device) feeding
+        straight into the boundary update — grads never leave the device and
+        there is a single dispatch per optimizer step (the reference needs
+        gas+1 host round-trips, deepspeed_light.py:603-807; the split API
+        here needed gas fwd dispatches + an accumulate + a step dispatch)."""
+        local = self._make_fused_local()
         master_spec, opt_spec, ls_spec = self._step_specs()
         fn = jax.shard_map(
             local, mesh=self.mesh,
@@ -2693,10 +2727,14 @@ class DeepSpeedTpuEngine:
         self._maybe_graph_lint(
             "train_batch", key,
             lambda: graph_lint.analyze_engine_train_batch(self, batch))
+        # explicitly K=1: THIS path dispatches the single-step program,
+        # whatever train_steps_per_dispatch says (train_many has its own
+        # gate pricing the real block size)
         self._maybe_capacity_plan(
             "train_batch", key,
-            lambda: self.plan_capacity(batch, train=True, fused=True),
-            batch=batch)
+            lambda: self.plan_capacity(batch, train=True, fused=True,
+                                       steps_per_dispatch=1),
+            batch=batch, steps_per_dispatch=1)
         spool = self._spool
         if spool is not None:
             self._telemetry.note_spool_base_step(self.global_steps)
@@ -2749,6 +2787,370 @@ class DeepSpeedTpuEngine:
             else:
                 self.tput_timer.stop(sync_on=loss)
         return loss
+
+    # --------------------------------------------- multi-step fused driver
+
+    def _build_train_many(self, batch, k):
+        """ONE jitted program fusing K optimizer steps — K invocations of
+        the fused per-step body chained inside one shard_map, one host
+        dispatch per K steps (WALLCLOCK §7's per-step fixed cost
+        amortized K×; ROADMAP item 4).
+
+        Bitwise-parity architecture (the contract: identical trajectory
+        to K serial ``train_batch`` dispatches, pinned by
+        tests/test_multistep.py across ZeRO stages, gas>1 and
+        fp16-with-skips).  Two measured XLA-CPU hazards shape the form:
+
+        * a dot whose operand is a bitcast/slice of a leading-[K]-stacked
+          parameter compiles to a kLoop fusion with a different
+          accumulation order than the runtime-dot call the per-step
+          program makes (``optimization_barrier`` does not stop the
+          fold) — so each step's batch is a SEPARATE program argument
+          and the K iterations unroll at trace time instead of scanning
+          a stacked tree;
+        * fusion heuristics are graph-global: the same per-step subgraph
+          embedded K× re-fuses its elementwise/reduction clusters
+          (~1-ulp re-association in the Adam moment chain) — so each
+          step body runs inside a ``lax.cond`` whose predicate is
+          runtime-true: cond branches compile as their OWN XLA
+          computations, giving every fused step exactly the standalone
+          program's compilation.  The predicate reads a dedicated
+          replicated ``live`` input (``_live_flag``) rather than any
+          carried state: a carried value passes through earlier branch
+          outputs, which the collective-consistency lint conservatively
+          rank-taints (at ZeRO-3 the step body uses ``axis_index``), and
+          a tainted cond predicate with collectives in one branch is the
+          lint's deadlock signature.  A fresh input is never tainted —
+          and never constant-folded.
+
+        Per-step semantics inside the program:
+
+        * the fp16/nan-sentinel skip contract holds PER STEP — overflow
+          gates the update through the existing ``jnp.where`` path in
+          ``_make_step_local``, never a host read;
+        * the loss-scale FSM advances per step through the chained
+          ``ls_state``;
+        * hypers arrive as ONE staged ``[K, 4, G]`` block
+          (``_stage_hypers_many``): step i reads row ``h_idx``, and under
+          a skip contract WITH an LR scheduler ``h_idx`` only advances on
+          non-skipped steps — exactly the serial "no scheduler step on a
+          skipped boundary" semantics, resolved on device.
+        """
+        single = self._make_fused_local()
+        skip_bad = self.config.fp16_enabled or self._nan_sentinel
+        # row selection is dynamic only when rows can differ AND a skip
+        # can hold a row back; otherwise the static row i is the same
+        # value and the gather is dead weight
+        dynamic_hypers = skip_bad and self.lr_scheduler is not None
+
+        def local(params, master, opt_state, ls_state, hypers_k,
+                  normw, gids, live, *batch_ks):
+            h_idx = jnp.int32(0)
+            overflows, norms, losses, scales = [], [], [], []
+
+            def stepped(operands):
+                p, m, o, ls, hy, ba = operands
+                return single(p, m, o, ls, hy, normw, gids, ba)
+
+            def untaken(operands):
+                # never executed (the predicate is runtime-true); exists
+                # only so each real step body is a cond BRANCH — its own
+                # XLA computation — instead of open graph
+                p, m, o, ls, hy, ba = operands
+                shapes = jax.eval_shape(stepped, operands)
+                zeros = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes[4:])
+                return (p, m, o, ls) + tuple(zeros)
+
+            for i in range(k):
+                if dynamic_hypers:
+                    hypers = jax.lax.dynamic_index_in_dim(
+                        hypers_k, h_idx, 0, keepdims=False)
+                else:
+                    hypers = hypers_k[i]
+                # the scale in effect FOR this step (pre-FSM-update) —
+                # what the spool records, captured in-program instead of
+                # the fused path's pre-dispatch host copy
+                scales.append(jnp.asarray(ls_state.cur_scale, jnp.float32))
+                (params, master, opt_state, ls_state, overflow,
+                 total_norm, last_loss) = jax.lax.cond(
+                    live > 0, stepped, untaken,
+                    (params, master, opt_state, ls_state, hypers,
+                     batch_ks[i]))
+                overflows.append(jnp.asarray(overflow, jnp.bool_))
+                norms.append(total_norm)
+                losses.append(last_loss)
+                if dynamic_hypers:
+                    h_idx = h_idx + jnp.where(overflow, jnp.int32(0),
+                                              jnp.int32(1))
+            losses_k = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *losses)
+            return (params, master, opt_state, ls_state,
+                    jnp.stack(overflows), norms[-1], losses[-1],
+                    jnp.stack(norms), losses_k, jnp.stack(scales))
+
+        master_spec, opt_spec, ls_spec = self._step_specs()
+        batch_spec = self._checked_batch_specs(batch)
+        shard_fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
+                      P(), P(DATA_AXIS), P(DATA_AXIS), P())
+                     + tuple(batch_spec for _ in range(k)),
+            out_specs=(self._param_specs, master_spec, opt_spec, ls_spec,
+                       P(), P(), P(), P(), P(), P()),
+            check_vma=False)
+        if self._spool is not None:
+            # K ring appends per dispatch — pure consumers of the per-step
+            # outputs, exactly the fused path's trajectory-neutrality
+            # argument; the drain still runs once per report window
+            # (config guarantees window % K == 0)
+            from deepspeed_tpu.observability import spool as spool_mod
+
+            def fn(params, master, opt_state, ls_state, hypers_k, normw,
+                   gids, live, batches, spool_state):
+                outs = shard_fn(params, master, opt_state, ls_state,
+                                hypers_k, normw, gids, live, *batches)
+                (_, _, _, _, overflows, _, _, norms_k, losses_k,
+                 scales_k) = outs
+                for i in range(k):
+                    loss_i = jax.tree_util.tree_map(lambda l: l[i],
+                                                    losses_k)
+                    spool_state = spool_mod.append(
+                        spool_state, loss_i, norms_k[i], scales_k[i],
+                        overflows[i])
+                return outs + (spool_state,)
+        else:
+            def fn(params, master, opt_state, ls_state, hypers_k, normw,
+                   gids, live, batches):
+                return shard_fn(params, master, opt_state, ls_state,
+                                hypers_k, normw, gids, live, *batches)
+
+        # donation: the same (params, master, opt_state, ls_state)
+        # positions as the fused single-step program, same fp32 guard
+        return jax.jit(fn, donate_argnums=self._donate_argnums(fused=True))
+
+    def _hyper_rows_host(self):
+        """Host tuple of the CURRENT facade hyperparameters, one
+        (lr, beta1, beta2, weight_decay) entry per param group — the
+        cache key AND value behind both hyper stagings."""
+        base = self.base_optimizer
+        groups = self.optimizer.param_groups
+        betas = [g.get("betas", (base.beta1, base.beta2)) for g in groups]
+        return tuple((float(g["lr"]), float(b[0]), float(b[1]),
+                      float(g.get("weight_decay", base.weight_decay)))
+                     for g, b in zip(groups, betas))
+
+    def _stage_hypers_many(self, k):
+        """The ``[K, 4, G]`` hyper block for one K-fused dispatch: row j
+        holds the hypers in effect after j non-skipped boundaries.  With
+        an LR scheduler the prospective rows come from stepping the
+        scheduler on a SNAPSHOT (state + facade groups restored after),
+        so the host scheduler state only advances when the block's real
+        skip outcome is known (``_post_block_bookkeeping`` replays one
+        ``step()`` per non-skipped boundary).  Cached on the host row
+        values — zero transfers when nothing moved."""
+        sched = self.lr_scheduler
+        if sched is None:
+            rows_k = [self._hyper_rows_host()] * k
+        else:
+            if not (hasattr(sched, "state_dict")
+                    and hasattr(sched, "load_state_dict")):
+                raise DeepSpeedConfigError(
+                    f"train_steps_per_dispatch > 1 with an LR scheduler "
+                    f"needs state_dict/load_state_dict on the scheduler "
+                    f"(to stage the K prospective hyper rows); "
+                    f"{type(sched).__name__} has neither")
+            sd = sched.state_dict()
+            saved_groups = [dict(g) for g in self.optimizer.param_groups]
+            saved_last_lr = getattr(sched, "_last_lr", None)
+            rows_k = []
+            for j in range(k):
+                rows_k.append(self._hyper_rows_host())
+                if j < k - 1:
+                    sched.step()
+            sched.load_state_dict(sd)
+            for g, s in zip(self.optimizer.param_groups, saved_groups):
+                g.clear()
+                g.update(s)
+            if saved_last_lr is not None:
+                sched._last_lr = saved_last_lr
+        key = (tuple(rows_k), k)
+        if key != self._hyper_many_key:
+            block = np.asarray(
+                [[[r[c] for r in row] for c in range(4)]
+                 for row in rows_k], np.float32)      # [K, 4, G]
+            self._hyper_many_dev = jnp.asarray(block)
+            self._hyper_many_key = key
+        return self._hyper_many_dev
+
+    def train_many(self, batches):
+        """K optimizer steps — K full effective batches — in ONE compiled
+        dispatch (the on-device multi-step driver, ROADMAP item 4;
+        docs/features.md "Multi-step driver").
+
+        ``batches`` is a sequence of K ``train_batch``-format batches
+        (identical format; K is its length — typically
+        ``config.train_steps_per_dispatch``, grouped by
+        ``data.BlockPrefetcher``).  Trajectory contract: bitwise
+        identical to K serial ``train_batch`` calls on the same batches
+        (tests/test_multistep.py pins it across ZeRO stages 0/1/3,
+        gas>1 and fp16-with-skips).  Returns the LAST step's loss.
+
+        Host-boundary accounting per K steps: one program dispatch, one
+        batch staging, at most ONE deliberate fence (the skip-contract
+        overflow vector read — deferred entirely to the window drain
+        when the metric spool is on and no scheduler retains it), and
+        the watchdog armed once with a K-scaled deadline.  Preemption
+        (``resilience.run_resumable``) polls between dispatches, so the
+        documented drain granularity becomes ≤ K steps."""
+        assert self.training, "train_many() requires train mode"
+        if not isinstance(batches, (list, tuple)) or len(batches) == 0:
+            raise ValueError(
+                "train_many: pass a non-empty sequence of train_batch-"
+                "format batches (one per fused optimizer step)")
+        self._force_live_pendings()  # train_many mutates params
+        batches = tuple(_as_tuple(b) for b in batches)
+        k = len(batches)
+        gas = self.gradient_accumulation_steps()
+        fmt_keys = [self._batch_cache_key(b) for b in batches]
+        if any(fk != fmt_keys[0] for fk in fmt_keys[1:]):
+            raise ValueError(
+                "train_many: every batch in a K-block must share one "
+                "format (pytree structure + leaf shapes/dtypes); mixed "
+                "formats must go through separate blocks")
+        leads = {x.shape[0] for x in jax.tree_util.tree_leaves(batches[0])}
+        if len(leads) != 1:
+            raise ValueError(
+                f"train_many: batch leaves disagree on the leading dim "
+                f"({sorted(leads)}); every leaf must carry the same "
+                f"[gas * micro * dp] axis")
+        lead = leads.pop()
+        if lead % gas != 0:
+            raise ValueError(
+                f"train_many: leading batch dim {lead} is not divisible "
+                f"by gradient_accumulation_steps={gas}")
+        key = (k, fmt_keys[0])
+        if self._train_many_fn is None or self._train_many_key != key:
+            self._train_many_fn = self._cached_batch_fn(
+                self._train_many_fns, key,
+                lambda: self._build_train_many(batches[0], k))
+            self._train_many_key = key
+        self._maybe_graph_lint(
+            "train_many", key,
+            lambda: graph_lint.analyze_engine_train_many(self, batches))
+        self._maybe_capacity_plan(
+            "train_many", key,
+            lambda: self.plan_capacity(batches[0], train=True, fused=True,
+                                       steps_per_dispatch=k),
+            batch=batches[0], steps_per_dispatch=k)
+        spool = self._spool
+        if spool is not None:
+            self._telemetry.note_spool_base_step(self.global_steps)
+            self._telemetry.note_predictions(self, batches[0])
+            self._maybe_graph_lint(
+                "spool_drain", "spool",
+                lambda: graph_lint.analyze_jaxpr(
+                    jax.make_jaxpr(spool.drain_program())(spool.state),
+                    subject="spool_drain"))
+            if spool.would_straddle(k):
+                # a stray train_batch on this K>1 engine left the ring
+                # mid-window: this block's K in-program appends would
+                # wrap over undrained rows BEFORE any drain could read
+                # them, silently misattributing a whole window.  Deliver
+                # the partial window first — one counted fence, paid
+                # only by mixed train_batch/train_many usage
+                spool.flush()
+        args = graph_lint.train_many_args(self, batches)
+        # armed ONCE around the K-step region, deadline scaled by K: a
+        # healthy K-block must not fire a deadline tuned for one step
+        # (docs/resilience.md "Watchdog tuning")
+        with self._armed("train_many", deadline_scale=k), \
+                _annotate("train_many"):
+            from deepspeed_tpu.resilience import chaos as _chaos
+            _t0 = time.monotonic()
+            _flightrec.record("arm", label="train_many",
+                              step=self.global_steps, block=k)
+            _chaos.maybe_stall(self.global_steps)
+            _t1 = time.monotonic()
+            outs = self._train_many_fn(*args)
+            if spool is not None:
+                outs, new_spool = outs[:-1], outs[-1]
+            (self.params, new_master, self.opt_state, self.loss_scale_state,
+             overflows, self._last_grad_norm, loss, _norms_k, _losses_k,
+             _scales_k) = outs
+            if self.zero_flat:
+                self.master_flat = new_master
+            else:
+                self.master = new_master
+            self.micro_steps += gas * k
+            self._last_loss = loss
+            if spool is not None:
+                # adopt the ring carrying K in-program appends; the drain
+                # still fires once per report window (window % K == 0)
+                spool.note_appends(new_spool, k)
+            self._post_block_bookkeeping(overflows, k)
+            self._telemetry.note_boundary_host_seconds(
+                _t1 - _t0, time.monotonic() - _t0)
+            # goodput rides the telemetry window drains at K > 1; the
+            # PR 1 window-fence reporter would reintroduce a per-block
+            # stall for a number the spool already measures
+            self.tput_timer.stop(report_speed=False, sync_on=None)
+        return loss
+
+    def _post_block_bookkeeping(self, overflows, k):
+        """Counters, skip accounting, scheduler replay and reporting
+        after a K-fused dispatch — ``_post_boundary_bookkeeping``'s block
+        form.  The per-boundary overflow host read becomes ONE read of
+        the ``[K]`` skip vector per block (amortized K×), or no read at
+        all when the spool defers it to the window drain."""
+        prev = self.global_steps
+        self.global_steps += k
+        _flightrec.record("boundary", step=self.global_steps, block=k)
+        self._profile_window()
+        self._telemetry.maybe_trace(self.global_steps)
+        skip_contract = self.config.fp16_enabled or self._nan_sentinel
+        defer = (skip_contract
+                 and self._telemetry.defers_overflow(self))
+        sched = self.lr_scheduler
+        if skip_contract and not defer:
+            # ONE fence per K steps: the whole skip vector in one read
+            # (observability/fences.py counts it; the dispatch plan
+            # prices it at 1/K per step)
+            flags = np.asarray(
+                obs_fences.read_arrays(overflows)[0]).astype(bool)
+            n_skip = int(flags.sum())
+            self.overflow = bool(flags[-1])
+            self.skipped_steps += n_skip
+            if n_skip and self._nan_sentinel \
+                    and not self.config.fp16_enabled:
+                from deepspeed_tpu.resilience import COUNTERS
+                COUNTERS.nan_skips += n_skip
+                logger.warning(
+                    "resilience: %d non-finite-gradient boundar%s skipped "
+                    "in the K-block ending at global step %d "
+                    "(nan_sentinel, fused)", n_skip,
+                    "y" if n_skip == 1 else "ies", self.global_steps)
+            if sched is not None:
+                # replay exactly the non-skipped boundaries: the device
+                # side already consumed the matching prospective hyper
+                # rows (h_idx gating), this re-syncs the host scheduler
+                for skipped in flags:
+                    if not skipped:
+                        sched.step()
+        else:
+            # statically finite, or deferred: the window drain settles
+            # skipped_steps/overflow retroactively (Telemetry._on_window)
+            self.overflow = False
+            if sched is not None:
+                for _ in range(k):
+                    sched.step()
+        spp = self.steps_per_print()
+        if spp and self.global_steps // spp != prev // spp:
+            self._report_progress(self.global_steps)
+        if self.summary_writer is not None \
+                and not self._telemetry.spool_active:
+            self._telemetry.emit_boundary_scalars(
+                getattr(self, "sample_count", self.global_steps))
 
     # ------------------------------------------------------------- reporting
 

@@ -209,8 +209,21 @@ class InferenceEngine:
                                   self._cache_specs)
 
         # ---- the two compiled programs, lint- and memplan-gated ----
+        # (with decode_iters_per_dispatch > 1 the decode program is the
+        # D-fused decode_many: still exactly TWO executables — the
+        # serial decode builder stays available as the non-greedy
+        # sampler fallback but only compiles if actually dispatched)
+        self.decode_iters_per_dispatch = int(
+            self.config.inference_decode_iters_per_dispatch)
+        self._live_flag = jax.device_put(
+            jnp.ones((), jnp.int32),
+            NamedSharding(self.mesh, P()))
         self._prefill_fn = self._build_prefill()
         self._decode_fn = self._build_decode()
+        self._decode_many_fn = (
+            self._build_decode_many(self.decode_iters_per_dispatch)
+            if self.decode_iters_per_dispatch > 1 else None)
+        self._warned_fused_fallback = False
         self._gate_programs()
 
     # ------------------------------------------------------------ helpers
@@ -287,7 +300,10 @@ class InferenceEngine:
             check_vma=False)
         return jax.jit(fn, donate_argnums=self._donate_argnums())
 
-    def _build_decode(self):
+    def _decode_shard_fn(self):
+        """The (unjitted) shard_mapped one-token decode program — shared
+        by ``_build_decode`` (one iteration per dispatch) and
+        ``_build_decode_many`` (D iterations fused per dispatch)."""
         model = self.module
         ring = self.cache_spec.ring
 
@@ -295,14 +311,76 @@ class InferenceEngine:
             return model.apply_decode(params, tokens, k, v, pos, active,
                                       ring=ring)
 
-        fn = jax.shard_map(
+        return jax.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._param_specs, self._cache_specs["k"],
                       self._cache_specs["v"], P(), P(), P()),
             out_specs=(P(None, MODEL_AXIS), self._cache_specs["k"],
                        self._cache_specs["v"], P()),
             check_vma=False)
-        return jax.jit(fn, donate_argnums=self._donate_argnums())
+
+    def _build_decode(self):
+        return jax.jit(self._decode_shard_fn(),
+                       donate_argnums=self._donate_argnums())
+
+    def _build_decode_many(self, d):
+        """ONE jitted program fusing D decode iterations — the serving
+        analog of the training multi-step driver (docs/inference.md
+        "Fused decode"): the per-iteration host boundary (dispatch +
+        logits fence + sampler) amortizes D×, cutting inter-token
+        latency the same way ``train_many`` cuts per-step fixed cost.
+
+        Greedy-only by construction: the token feedback loop closes ON
+        DEVICE via argmax, so the host sees tokens every D iterations
+        (admission/eviction granularity becomes D tokens — the
+        scheduler's documented contract).  Per-slot eos/budget masking
+        runs in-program: a slot that finishes mid-block stops consuming
+        positions and emits nothing further, so the greedy-output
+        identity and batching-invariance contracts carry over exactly
+        (tests/test_multistep.py pins fused == serial token streams).
+
+        Each iteration's decode body runs inside a ``lax.cond`` with the
+        runtime-true ``live`` input — the same compilation-isolation
+        trick as ``engine._build_train_many`` (XLA-CPU re-fuses an
+        embedded subgraph differently than the standalone program,
+        re-associating logits by ~1 ulp; near-tie argmax then breaks the
+        identity contract)."""
+        decode_shard = self._decode_shard_fn()
+
+        def many(params, k, v, pos, tokens, active, eos_ids, remaining,
+                 live):
+            def stepped(ops):
+                k, v, pos, tokens, active = ops
+                return decode_shard(params, k, v, pos, tokens, active)
+
+            def untaken(ops):
+                k, v, pos, tokens, active = ops
+                logits = jax.eval_shape(stepped, ops)[0]
+                return (jnp.zeros(logits.shape, logits.dtype), k, v, pos)
+
+            toks_out, emitted_out = [], []
+            for _ in range(d):
+                logits, k, v, pos = jax.lax.cond(
+                    live > 0, stepped, untaken,
+                    (k, v, pos, tokens, active))
+                # greedy sampling on device, over the fp32 view the host
+                # sampler sees (np.argmax of the float32 logits row) —
+                # same first-max tie-breaking
+                nxt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                emitted = active
+                remaining = remaining - active.astype(jnp.int32)
+                hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
+                active = jnp.logical_and(
+                    active, jnp.logical_and(jnp.logical_not(hit_eos),
+                                            remaining > 0))
+                tokens = jnp.where(emitted, nxt, tokens)
+                toks_out.append(nxt)
+                emitted_out.append(emitted)
+            return (jnp.stack(toks_out), jnp.stack(emitted_out),
+                    k, v, pos, active, remaining)
+
+        return jax.jit(many, donate_argnums=self._donate_argnums())
 
     def _program_args(self, kind: str):
         """Example argument tuples for tracing (lint + planner) — shapes
@@ -310,23 +388,43 @@ class InferenceEngine:
         shapes = kvcache.cache_jax_shapes(self.cache_spec)
         k, v = shapes["k"], shapes["v"]
         pos = shapes["pos"]
+        slots = self.cache_spec.slots
         if kind == "prefill":
             return (self.params, k, v, pos,
                     jax.ShapeDtypeStruct((1, self.prefill_bucket),
                                          jnp.int32),
                     jax.ShapeDtypeStruct((), jnp.int32),
                     jax.ShapeDtypeStruct((), jnp.int32))
+        if kind == "decode_many":
+            return (self.params, k, v, pos,
+                    jax.ShapeDtypeStruct((slots,), jnp.int32),
+                    jax.ShapeDtypeStruct((slots,), jnp.bool_),
+                    jax.ShapeDtypeStruct((slots,), jnp.int32),
+                    jax.ShapeDtypeStruct((slots,), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32))
         return (self.params, k, v, pos,
-                jax.ShapeDtypeStruct((self.cache_spec.slots,), jnp.int32),
-                jax.ShapeDtypeStruct((self.cache_spec.slots,), jnp.bool_))
+                jax.ShapeDtypeStruct((slots,), jnp.int32),
+                jax.ShapeDtypeStruct((slots,), jnp.bool_))
+
+    def _gated_programs(self):
+        """(kind, fn) pairs of every program production CAN dispatch.
+        At ``decode_iters_per_dispatch`` > 1 BOTH decode forms are
+        gated: the continuous greedy path runs ``decode_many``, but the
+        StaticScheduler baseline and the custom-sampler fallback still
+        dispatch the per-iteration ``decode`` — a program that can run
+        must not skip the error-mode lint/memplan gates."""
+        out = [("prefill", self._prefill_fn),
+               ("decode", self._decode_fn)]
+        if self._decode_many_fn is not None:
+            out.append(("decode_many", self._decode_many_fn))
+        return tuple(out)
 
     def run_graph_lint(self) -> graph_lint.Report:
         """Jaxpr passes over BOTH serving programs (the CLI/test surface,
         ignoring ``graph_lint.mode``)."""
         mesh_axes = list(self.mesh.shape.keys())
         rep = graph_lint.Report(subject="serve")
-        for kind, fn in (("prefill", self._prefill_fn),
-                         ("decode", self._decode_fn)):
+        for kind, fn in self._gated_programs():
             closed = jax.make_jaxpr(fn)(*self._program_args(kind))
             rep.extend(graph_lint.analyze_jaxpr(
                 closed, mesh_axes=mesh_axes, subject=kind))
@@ -383,8 +481,7 @@ class InferenceEngine:
         if budget_bytes is None and explicit is not None:
             budget_bytes = explicit.hbm_bytes
         programs = []
-        for kind, fn in (("prefill", self._prefill_fn),
-                         ("decode", self._decode_fn)):
+        for kind, fn in self._gated_programs():
             programs.append(memplan.analyze_program(
                 fn, self._program_args(kind),
                 donate_argnums=self._donate_argnums(),
@@ -532,6 +629,42 @@ class InferenceEngine:
         # one counted fence per decode iteration (sampler dependency;
         # the dispatch plan's predicted fence counter)
         return np.asarray(obs_fences.read_arrays(logits)[0], np.float32)
+
+    def decode_many(self, tokens, active, eos_ids, remaining):
+        """D fused decode iterations in ONE dispatch
+        (``inference.decode_iters_per_dispatch``; greedy sampling closes
+        on device).  ``eos_ids`` int32 [slots] (-1 = length-only stop),
+        ``remaining`` int32 [slots] (token budget left per slot).
+        Returns ``(tokens [D, slots] int32, emitted [D, slots] bool)`` —
+        ``emitted[it, s]`` marks slot s active at iteration ``it``
+        (tokens where it is False are meaningless).  ONE counted fence
+        per D iterations — the ITL win the bench measures."""
+        if self._decode_many_fn is None:
+            raise RuntimeError(
+                "decode_many needs inference.decode_iters_per_dispatch "
+                "> 1 (the fused decode program was not built)")
+        toks, emitted, kb, vb, pos, _active, _rem = self._decode_many_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            self._cache["pos"], np.asarray(tokens, np.int32),
+            np.asarray(active, bool), np.asarray(eos_ids, np.int32),
+            np.asarray(remaining, np.int32), self._live_flag)
+        self._cache = {"k": kb, "v": vb, "pos": pos}
+        # the sampler fence, amortized: one counted read per D-block
+        # instead of one per token (dispatch plan prices it at 1/D)
+        out = obs_fences.read_arrays(toks, emitted)
+        return np.asarray(out[0]), np.asarray(out[1]).astype(bool)
+
+    def note_fused_decode_fallback(self, why: str) -> None:
+        """One-shot warning when a scheduler cannot use the built fused
+        decode (non-greedy sampler): serving silently at 1 iteration per
+        dispatch while the config promises D would hide the regression."""
+        if not self._warned_fused_fallback:
+            self._warned_fused_fallback = True
+            logger.warning(
+                "inference: decode_iters_per_dispatch=%d requested but "
+                "%s — falling back to one decode dispatch per iteration "
+                "(docs/inference.md \"Fused decode\")",
+                self.decode_iters_per_dispatch, why)
 
     def slot_positions(self) -> np.ndarray:
         return np.asarray(self._cache["pos"])

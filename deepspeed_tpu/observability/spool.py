@@ -110,9 +110,40 @@ class MetricSpool:
     def note_append(self, new_state) -> None:
         """Adopt the step program's updated spool state (fused path: the
         append ran inside train_batch) and auto-drain on window edges."""
+        self.note_appends(new_state, 1)
+
+    def would_straddle(self, n: int) -> bool:
+        """True when ``n`` further appends would cross a window edge
+        MID-BATCH: the ring holds exactly one window, so an in-program
+        n-append that wraps past an undrained edge overwrites rows
+        before any drain can read them.  Pure K-block runs never
+        straddle (config pins ``window % K == 0``); a run that mixed a
+        stray single append in can — the engine flushes first
+        (``train_many``; one counted fence, mixed usage only)."""
+        return (self._appended % self.window) + int(n) > self.window
+
+    def note_appends(self, new_state, n: int) -> None:
+        """Adopt a state carrying ``n`` in-program appends (the K-fused
+        multi-step driver appends once per optimizer step INSIDE the
+        dispatch).  The config layer guarantees ``window % K == 0``, so a
+        window edge can only land exactly at a block edge — ``n`` appends
+        never straddle one (a straddled edge would overrun the ring
+        before the drain could read it)."""
+        if n > self.window:
+            # unreachable through the engine (config validates window
+            # alignment) — but an overrun must be loud, never silent
+            raise ValueError(
+                f"spool: {n} appends in one dispatch exceed the "
+                f"report window ({self.window}); rows would be "
+                f"overwritten before any drain could deliver them")
         self.state = new_state
-        self._appended += 1
-        if self._appended % self.window == 0:
+        before = self._appended
+        self._appended += int(n)
+        # drain on every window-edge CROSSING, not only exact alignment:
+        # a run mixing train_batch (1 append) and train_many (K appends)
+        # can land past an edge — the drain then delivers a short window
+        # rather than silently never draining again
+        if before // self.window != self._appended // self.window:
             self.drain_async()
 
     def append_split(self, loss_out, grad_norm, loss_scale, overflow) -> None:

@@ -79,19 +79,28 @@ class Watchdog:
         self._lock = threading.Lock()
         self._armed_label = None
         self._armed_at = None
+        self._armed_deadline_s = self.timeout_s
         self._fired_this_arm = False
         self._thread = None
 
     # ------------------------------------------------------------- arming
     @contextmanager
-    def armed(self, label: str):
-        self._arm(label)
+    def armed(self, label: str, deadline_scale: float = 1.0):
+        """``deadline_scale`` stretches THIS region's deadline (and its
+        near-miss threshold): the multi-step driver arms once around a
+        K-step fused dispatch, so a deadline tuned for one boundary must
+        scale by K or every healthy K-block fires it
+        (docs/resilience.md "Watchdog tuning")."""
+        self._arm(label, deadline_scale)
         try:
             yield self
         finally:
             self._disarm()
 
-    def _arm(self, label: str) -> None:
+    def _arm(self, label: str, deadline_scale: float = 1.0) -> None:
+        if deadline_scale <= 0:
+            raise ValueError(
+                f"watchdog deadline_scale must be > 0, got {deadline_scale}")
         self._ensure_thread()
         with self._lock:
             if self._armed_label is not None:
@@ -100,24 +109,27 @@ class Watchdog:
                     f"armed regions do not nest (attempted {label!r})")
             self._armed_label = label
             self._armed_at = time.monotonic()
+            self._armed_deadline_s = self.timeout_s * float(deadline_scale)
             self._fired_this_arm = False
 
     def _disarm(self) -> None:
         with self._lock:
             label, at = self._armed_label, self._armed_at
+            deadline = self._armed_deadline_s
             fired = self._fired_this_arm
             self._armed_label = None
             self._armed_at = None
+            self._armed_deadline_s = self.timeout_s
             self._fired_this_arm = False
         if at is None:
             return
         dur = time.monotonic() - at
         self.timings.append((label, dur))
-        if not fired and dur > self.near_miss_frac * self.timeout_s:
+        if not fired and dur > self.near_miss_frac * deadline:
             COUNTERS.watchdog_near_misses += 1
             logger.warning(
                 "watchdog near-miss: %r took %.2fs of a %.2fs deadline",
-                label, dur, self.timeout_s)
+                label, dur, deadline)
 
     # ------------------------------------------------------------ monitor
     def _ensure_thread(self) -> None:
@@ -131,14 +143,16 @@ class Watchdog:
             time.sleep(self.poll_s)
             with self._lock:
                 label, at = self._armed_label, self._armed_at
+                deadline = self._armed_deadline_s
                 already = self._fired_this_arm
                 if (label is None or already
-                        or time.monotonic() - at <= self.timeout_s):
+                        or time.monotonic() - at <= deadline):
                     continue
                 self._fired_this_arm = True
-            self._fire(label, time.monotonic() - at)
+            self._fire(label, time.monotonic() - at, deadline)
 
-    def _fire(self, label: str, elapsed: float) -> None:
+    def _fire(self, label: str, elapsed: float,
+              deadline_s: float = None) -> None:
         recent = "\n".join(f"  {lbl}: {dur * 1000.0:.1f} ms"
                            for lbl, dur in self.timings) or "  (none)"
         # flight-recorder enrichment (observability/flightrec.py): the
@@ -152,7 +166,8 @@ class Watchdog:
             flight = flightrec.RECORDER.format_tail()
         except Exception:  # pragma: no cover - defensive
             pass
-        dump = (f"WATCHDOG: {label!r} exceeded {self.timeout_s:.2f}s "
+        deadline_s = self.timeout_s if deadline_s is None else deadline_s
+        dump = (f"WATCHDOG: {label!r} exceeded {deadline_s:.2f}s "
                 f"deadline ({elapsed:.2f}s elapsed)\n"
                 f"last {len(self.timings)} armed-operation timings:\n"
                 f"{recent}\n"

@@ -95,19 +95,52 @@ def main():
         print(f"resumed from {path} at step {start}")
 
     it = iter(dataloader)
-    for step in range(start, args.steps):
+
+    def next_batch(step):
+        nonlocal it
         try:
-            batch = next(it)
+            return next(it)
         except StopIteration:
             dataloader.set_epoch(step)   # reshuffle
             it = iter(dataloader)
-            batch = next(it)
-        loss = engine(*batch)
-        engine.backward(loss)
-        engine.step()
-        if step % 20 == 0:
-            print(f"step {step:4d}  loss {float(loss):.5f}  "
-                  f"scale {optimizer.cur_scale:.0f}")
+            return next(it)
+
+    k = engine.steps_per_dispatch
+    if k > 1:
+        # multi-step driver (config train_steps_per_dispatch): K fused
+        # optimizer steps per dispatch, blocks staged ahead by the
+        # double-buffered prefetcher (docs/features.md "Multi-step
+        # driver").  Bitwise-identical trajectory to the K=1 loop.
+        from deepspeed_tpu.data import BlockPrefetcher
+
+        def batches():
+            step = start
+            while True:
+                yield next_batch(step)
+                step += 1
+
+        for block in BlockPrefetcher(batches(), k=k):
+            need = args.steps - engine.global_steps
+            if need <= 0:
+                break
+            # clamp the trailing block so --steps is exact (a short
+            # final block compiles one extra K'-step program)
+            loss = engine.train_many(block[:need] if need < k else block)
+            step = engine.global_steps
+            if step % 20 < k:
+                print(f"step {step:4d}  loss {float(loss):.5f}  "
+                      f"scale {optimizer.cur_scale:.0f}")
+            if step >= args.steps:
+                break
+    else:
+        for step in range(start, args.steps):
+            batch = next_batch(step)
+            loss = engine(*batch)
+            engine.backward(loss)
+            engine.step()
+            if step % 20 == 0:
+                print(f"step {step:4d}  loss {float(loss):.5f}  "
+                      f"scale {optimizer.cur_scale:.0f}")
 
     # drain the final (possibly partial) telemetry window before exit —
     # a no-op unless the config enables the observability metric spool

@@ -1,0 +1,686 @@
+"""On-device multi-step driver (engine.train_many) + D-fused decode.
+
+The contracts this file pins (ISSUE 12):
+
+* **Bitwise trajectory parity**: K fused steps in ONE dispatch produce
+  the IDENTICAL master/loss-scale/LR/skip trajectory as K serial
+  ``train_batch`` dispatches — across ZeRO stages 0/1/2/3, gas>1,
+  fp16-with-skips (mid-block!), and with an LR scheduler (whose hypers
+  ride the scanned [K, 4, G] stage, h_idx-gated by the in-program skip
+  flags).
+* **Host-boundary accounting**: predicted executables ==
+  ``compile_cache_misses`` and predicted fences == ``FENCE_COUNT`` over
+  real K-fused runs (PR 11 style), with the skip-contract fence
+  amortized to once per K-block.
+* **Serving analog**: D fused decode iterations per dispatch keep the
+  greedy-output-identity and batching-invariance contracts, with one
+  counted fence per D-block.
+* **Resilience × K**: a preemption request lands mid-block and drains at
+  the NEXT K boundary with a bitwise resume; the watchdog deadline
+  scales by K so a healthy K-block never fires a 1-step deadline.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu import analysis, resilience
+from deepspeed_tpu.analysis import dispatchplan, stability
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.data import BlockPrefetcher
+from deepspeed_tpu.observability import fences as obs_fences
+from deepspeed_tpu.resilience import (COUNTERS, PreemptionHandler,
+                                      RESUME_EXIT_CODE, Watchdog, chaos)
+from deepspeed_tpu.utils import compile_cache
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from simple_model import SimpleModel, master_bytes  # noqa: E402
+
+HIDDEN = 8
+TINY_GPT2 = dict(vocab_size=64, max_seq_len=16, num_layers=2,
+                 hidden_size=32, num_heads=2)
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def make_engine(cfg):
+    engine, _, _, _ = ds.initialize(model=SimpleModel(hidden_dim=HIDDEN),
+                                    config=dict(cfg))
+    return engine
+
+
+def batch(i, n=16, dtype=np.float32, poison=False):
+    rng = np.random.default_rng(1000 + i)
+    x = rng.normal(size=(n, HIDDEN)).astype(dtype)
+    if poison:
+        x[0, 0] = np.inf
+    y = rng.integers(0, HIDDEN, size=(n,)).astype(np.int32)
+    return (x, y)
+
+
+def gpt2_engine(cfg):
+    from deepspeed_tpu.models.gpt2 import GPT2
+    engine, _, _, _ = ds.initialize(
+        model=GPT2.from_size("tiny", **TINY_GPT2), config=dict(cfg))
+    return engine
+
+
+def gpt2_batch(i, n=8):
+    rng = np.random.default_rng(2000 + i)
+    ids = rng.integers(0, 64, size=(n, 16)).astype(np.int32)
+    return (ids, ids)
+
+
+def trajectory_state(engine):
+    """Everything the parity contract compares: master bytes + the host
+    bookkeeping the block form must keep in lockstep."""
+    return (master_bytes(engine), engine.global_steps,
+            engine.skipped_steps, engine.optimizer.cur_scale,
+            tuple(g["lr"] for g in engine.optimizer.param_groups))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture
+def cold_cache(tmp_path):
+    d = str(tmp_path / "cc")
+    compile_cache.enable(d)
+    jax.clear_caches()
+    yield d
+    compile_cache.disable()
+
+
+# =====================================================================
+# bitwise trajectory parity: K fused vs K serial train_batch
+# =====================================================================
+
+PARITY_CASES = [
+    ("stage0_fp32_gas2", base_config(), np.float32),
+    ("stage0_bf16_gas2", base_config(bf16={"enabled": True}), np.float32),
+    ("stage1_fp16", base_config(zero_optimization={"stage": 1},
+                                fp16={"enabled": True,
+                                      "loss_scale": 128.0}),
+     np.float16),
+    ("stage2_bf16", base_config(zero_optimization={"stage": 2},
+                                bf16={"enabled": True}), np.float32),
+    ("fp16_dynamic_sched", base_config(
+        fp16={"enabled": True, "loss_scale": 0},
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_num_steps": 10,
+                              "warmup_max_lr": 0.01}}), np.float16),
+    ("bf16_sentinel", base_config(bf16={"enabled": True},
+                                  resilience={"nan_sentinel": True}),
+     np.float32),
+]
+
+
+@pytest.mark.parametrize("name,cfg,dtype",
+                         PARITY_CASES, ids=[c[0] for c in PARITY_CASES])
+def test_parity_bitwise(name, cfg, dtype):
+    K = 4
+    e1 = make_engine(cfg)
+    e2 = make_engine(cfg)
+    bs = [batch(i, dtype=dtype) for i in range(K)]
+    serial_losses = [e1.train_batch(b) for b in bs]
+    loss_many = e2.train_many(bs)
+    assert trajectory_state(e1) == trajectory_state(e2)
+    # the driver returns the LAST step's loss, equal to serial's
+    assert float(jax.tree_util.tree_leaves(serial_losses[-1])[0]) \
+        == float(jax.tree_util.tree_leaves(loss_many)[0])
+
+
+def test_parity_bitwise_zero3_gpt2():
+    """Stage 3 with really-partitioned GPT-2 leaves (dp=8 virtual
+    devices), lint + capacity gates in error mode riding along: the
+    cond-isolated K-step program must be gate-clean AND bitwise."""
+    cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 2,
+           "steps_per_print": 10 ** 9,
+           "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+           "bf16": {"enabled": True}, "zero_optimization": {"stage": 3},
+           "graph_lint": "error",
+           "analysis": {"mode": "error", "profile": "v4-8"}}
+    K = 3
+    e1 = gpt2_engine(cfg)
+    e2 = gpt2_engine(cfg)
+    bs = [gpt2_batch(i, n=16) for i in range(K)]
+    for b in bs:
+        e1.train_batch(b)
+    e2.train_many(bs)
+    assert master_bytes(e1) == master_bytes(e2)
+    assert e1.global_steps == e2.global_steps == K
+
+
+def test_parity_fp16_skip_mid_block_with_scheduler():
+    """A REAL overflow in the middle of a K-block under fp16 + LR
+    scheduler: the in-program h_idx gating must hold the prospective
+    hyper row back on the skipped boundary, and the host replay must
+    leave the scheduler at exactly the serial position — bitwise master,
+    identical skip count, identical LR."""
+    cfg = base_config(
+        fp16={"enabled": True, "loss_scale": 128.0},
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_num_steps": 10,
+                              "warmup_max_lr": 0.01}})
+    K = 4
+    e1 = make_engine(cfg)
+    e2 = make_engine(cfg)
+    bs = [batch(0, dtype=np.float16),
+          batch(1, dtype=np.float16, poison=True),   # skips mid-block
+          batch(2, dtype=np.float16),
+          batch(3, dtype=np.float16)]
+    for b in bs:
+        e1.train_batch(b)
+    e2.train_many(bs)
+    assert e1.skipped_steps == e2.skipped_steps == 1
+    assert trajectory_state(e1) == trajectory_state(e2)
+
+
+def test_parity_spool_on_off_and_deferred_skip(tmp_path):
+    """Trajectory neutrality of the K in-program spool appends (spool
+    on == spool off bitwise), and the deferred skip bookkeeping settling
+    at the window drain: a poisoned mid-block step under the nan
+    sentinel never takes a host read, yet skipped_steps catches up."""
+    K = 2
+    plain = base_config(bf16={"enabled": True},
+                        resilience={"nan_sentinel": True})
+    spooled = dict(plain)
+    spooled["train_steps_per_dispatch"] = K
+    spooled["observability"] = {
+        "report_window": 4, "jsonl_path": str(tmp_path / "t.jsonl")}
+    e1 = make_engine(plain)
+    e2 = make_engine(spooled)
+    blocks = [[batch(0), batch(1, poison=True)], [batch(2), batch(3)]]
+    f0 = obs_fences.FENCE_COUNT
+    for blk in blocks:
+        e1.train_many(blk)
+        e2.train_many(blk)
+    assert master_bytes(e1) == master_bytes(e2)
+    # spooled run: the [K] skip read DEFERS to the drain — zero fences
+    # beyond the plain engine's one per block
+    assert obs_fences.FENCE_COUNT - f0 == len(blocks)   # plain engine only
+    e2.flush_telemetry()
+    assert e2.skipped_steps == e1.skipped_steps == 1
+    events = [json.loads(l) for l in open(tmp_path / "t.jsonl")]
+    wins = [e for e in events if e["schema"].endswith(".window")]
+    assert [w["window_steps"] for w in wins] == [4]
+    assert wins[0]["skipped"] == 1
+
+
+def test_mixed_train_batch_then_block_flushes_straddle(tmp_path):
+    """A stray train_batch on a K>1 spooled engine leaves the ring
+    mid-window; the next K-block would wrap over the undrained row
+    IN-PROGRAM — train_many must deliver the partial window first
+    (would_straddle → flush), so every window row stays correctly
+    attributed."""
+    K = 4
+    engine = make_engine(base_config(
+        train_steps_per_dispatch=K, bf16={"enabled": True},
+        observability={"report_window": K,
+                       "jsonl_path": str(tmp_path / "t.jsonl")}))
+    serial = make_engine(base_config(bf16={"enabled": True}))
+    engine.train_batch(batch(0))                  # ring row 0, undrained
+    serial.train_batch(batch(0))
+    engine.train_many([batch(i) for i in range(1, K + 1)])
+    for i in range(1, K + 1):
+        serial.train_batch(batch(i))
+    engine.flush_telemetry()
+    assert master_bytes(engine) == master_bytes(serial)
+    evs = [json.loads(l) for l in open(tmp_path / "t.jsonl")]
+    wins = [e for e in evs if e["schema"].endswith(".window")]
+    # the straddle flush delivered the 1-row partial, then the block's
+    # crossing drain the 4-row window — 5 boundaries, none dropped or
+    # misattributed
+    assert [w["window_steps"] for w in wins] == [1, K]
+    assert [w["step"] for w in wins] == [1, 1 + K]
+
+
+def test_train_many_k1_matches_train_batch():
+    """K=1 through the multi-step builder is the degenerate case — still
+    bitwise with train_batch (same per-step body, cond-isolated)."""
+    e1 = make_engine(base_config(bf16={"enabled": True}))
+    e2 = make_engine(base_config(bf16={"enabled": True}))
+    e1.train_batch(batch(0))
+    e2.train_many([batch(0)])
+    assert trajectory_state(e1) == trajectory_state(e2)
+
+
+# =====================================================================
+# validation + config surface
+# =====================================================================
+
+def test_train_many_rejects_mixed_formats_and_bad_leads():
+    engine = make_engine(base_config(bf16={"enabled": True}))
+    with pytest.raises(ValueError, match="share one"):
+        engine.train_many([batch(0), batch(1, n=8)])
+    with pytest.raises(ValueError, match="non-empty"):
+        engine.train_many([])
+    with pytest.raises(ValueError, match="not divisible"):
+        engine.train_many([batch(0, n=15)])
+
+
+def test_config_window_must_be_multiple_of_k():
+    with pytest.raises(DeepSpeedConfigError, match="multiple"):
+        DeepSpeedConfig(base_config(
+            train_steps_per_dispatch=3,
+            observability={"report_window": 4}), dp_world_size=1)
+    # aligned is fine
+    cfg = DeepSpeedConfig(base_config(
+        train_steps_per_dispatch=3,
+        observability={"report_window": 6}), dp_world_size=1)
+    assert cfg.train_steps_per_dispatch == 3
+
+
+def test_config_env_escape_hatches(monkeypatch):
+    monkeypatch.setenv("DSTPU_MULTISTEP", "off")
+    cfg = DeepSpeedConfig(base_config(train_steps_per_dispatch=8),
+                          dp_world_size=1)
+    assert cfg.train_steps_per_dispatch == 1
+    monkeypatch.setenv("DSTPU_MULTISTEP", "4")
+    cfg = DeepSpeedConfig(base_config(), dp_world_size=1)
+    assert cfg.train_steps_per_dispatch == 4
+    monkeypatch.setenv("DSTPU_MULTISTEP", "soon")
+    with pytest.raises(DeepSpeedConfigError, match="DSTPU_MULTISTEP"):
+        DeepSpeedConfig(base_config(), dp_world_size=1)
+    monkeypatch.delenv("DSTPU_MULTISTEP")
+    with pytest.raises(DeepSpeedConfigError, match="must be >= 1"):
+        DeepSpeedConfig(base_config(train_steps_per_dispatch=0),
+                        dp_world_size=1)
+    monkeypatch.setenv("DSTPU_DECODE_ITERS", "off")
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "inference": {"decode_iters_per_dispatch": 4}},
+                          dp_world_size=1)
+    assert cfg.inference_decode_iters_per_dispatch == 1
+
+
+def test_spool_multi_append_overrun_is_loud():
+    from deepspeed_tpu.observability.spool import MetricSpool
+    spool = MetricSpool(2, lambda rows, pos: None)
+    with pytest.raises(ValueError, match="exceed the report window"):
+        spool.note_appends(spool.state, 3)
+
+
+def test_block_prefetcher_groups_and_propagates():
+    blocks = list(BlockPrefetcher(iter(range(7)), k=3))
+    assert blocks == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(BlockPrefetcher(iter(range(7)), k=3, drop_last=True)) \
+        == [[0, 1, 2], [3, 4, 5]]
+    placed = list(BlockPrefetcher(iter(range(4)), k=2,
+                                  place=lambda b: b * 10))
+    assert placed == [[0, 10], [20, 30]]
+
+    def boom():
+        yield 1
+        raise RuntimeError("collate exploded")
+    with pytest.raises(RuntimeError, match="collate exploded"):
+        list(BlockPrefetcher(boom(), k=1))
+    with pytest.raises(ValueError, match="k must be"):
+        BlockPrefetcher(iter([]), k=0)
+
+
+# =====================================================================
+# host-boundary contract: predicted executables + fences == runtime
+# counters (the PR 11 verification discipline)
+# =====================================================================
+
+def _counters():
+    return (COUNTERS.compile_cache_misses, obs_fences.FENCE_COUNT)
+
+
+def test_contract_multistep_fp16(cold_cache):
+    """fp16 K=4, spool off: ONE train_many executable for the whole run,
+    ONE skip-vector fence per K-block (the per-step overflow fence
+    amortized K×) — both exactly matching the static prediction."""
+    K, BLOCKS = 4, 3
+    engine = make_engine(base_config(
+        train_steps_per_dispatch=K,
+        fp16={"enabled": True, "loss_scale": 128.0}))
+    b = batch(0, dtype=np.float16)
+    m0, f0 = _counters()
+    for blk in range(BLOCKS):
+        engine.train_many([batch(blk * K + j, dtype=np.float16)
+                           for j in range(K)])
+
+    pred = stability.predict_executables(engine, [b], train=True,
+                                         fused=True)
+    assert [(k, n) for k, _, n in pred.programs] == [("train_many", 1)]
+    assert COUNTERS.compile_cache_misses - m0 == pred.total == 1
+
+    plan = engine.plan_dispatch(b, fused=True)
+    assert plan.subject == "train_many"
+    assert plan.fence_model.block_steps == K
+    assert plan.fence_model.per_boundary == 1
+    assert plan.fences_per_step() == 1.0 / K
+    n_steps = K * BLOCKS
+    assert obs_fences.FENCE_COUNT - f0 \
+        == plan.predict_fences(n_steps) == BLOCKS
+    # no per-step fence event survives at warning severity — the block
+    # read amortizes below the fence-per-step threshold
+    rep = plan.to_report()
+    assert not any(f.code == "dispatch.fence-per-step"
+                   for f in rep.warnings)
+
+
+def test_contract_multistep_spooled(cold_cache, tmp_path):
+    """bf16 + sentinel + spool at K=2: executables = train_many + the
+    drain program; ZERO per-block fences (deferred to the drain), one
+    counted flush."""
+    K, BLOCKS = 2, 4
+    engine = make_engine(base_config(
+        train_steps_per_dispatch=K,
+        bf16={"enabled": True}, resilience={"nan_sentinel": True},
+        observability={"report_window": 4,
+                       "jsonl_path": str(tmp_path / "t.jsonl")}))
+    b = batch(0)
+    m0, f0 = _counters()
+    for blk in range(BLOCKS):
+        engine.train_many([batch(blk * K + j) for j in range(K)])
+    engine.flush_telemetry()
+
+    pred = stability.predict_executables(engine, [b], train=True,
+                                         fused=True)
+    assert sorted(k for k, _, _ in pred.programs) == [
+        "spool_drain", "train_many"]
+    assert COUNTERS.compile_cache_misses - m0 == pred.total == 2
+
+    plan = engine.plan_dispatch(b, fused=True)
+    assert plan.fence_model.per_boundary == 0
+    assert plan.fence_model.flush_fences == 1
+    assert obs_fences.FENCE_COUNT - f0 \
+        == plan.predict_fences(K * BLOCKS, flushes=1) == 1
+
+
+def test_contract_decode_many(cold_cache):
+    """D-fused serving: still exactly TWO executables (prefill +
+    decode_many), one counted fence per admission and per D-block —
+    runtime counters matching the static serve plan."""
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2
+    D = 4
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "inference": {"max_slots": 3, "max_tokens": 16,
+                         "prefill_bucket": 8, "page_tokens": 16,
+                         "dtype": "float32",
+                         "decode_iters_per_dispatch": D},
+           "graph_lint": "error"}
+    engine = InferenceEngine(GPT2.from_size("tiny", **TINY_GPT2),
+                             config=cfg, seed=0)
+    m0, f0 = _counters()
+    prompts = [[1, 2, 3], [4, 5], [6]]
+    for slot, p in enumerate(prompts):
+        engine.prefill(slot, p)
+    blocks = 3
+    toks = np.zeros((engine.num_slots,), np.int32)
+    active = np.array([True, True, False])
+    eos = np.full((engine.num_slots,), -1, np.int32)
+    remaining = np.full((engine.num_slots,), 100, np.int32)
+    for _ in range(blocks):
+        toks_out, emitted = engine.decode_many(toks, active, eos,
+                                               remaining)
+        assert toks_out.shape == (D, engine.num_slots)
+        assert emitted[:, 2].sum() == 0          # inactive slot silent
+
+    pred = engine.predict_executables()
+    assert sorted(k for k, _, _ in pred.programs) == [
+        "decode_many", "prefill"]
+    assert pred.total == 2
+    assert COUNTERS.compile_cache_misses - m0 == 2
+
+    plans = engine.plan_dispatch()
+    assert plans["decode"].fence_model.block_steps == D
+    predicted = dispatchplan.serve_predict_fences(
+        plans, prefills=len(prompts), decode_iters=blocks * D)
+    assert obs_fences.FENCE_COUNT - f0 == predicted \
+        == len(prompts) + blocks
+
+
+# =====================================================================
+# D-fused decode: output contracts
+# =====================================================================
+
+def _serve_engine(d, **inf_over):
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2
+    inf = {"max_slots": 3, "max_tokens": 16, "prefill_bucket": 8,
+           "page_tokens": 16, "dtype": "float32",
+           "decode_iters_per_dispatch": d}
+    inf.update(inf_over)
+    return InferenceEngine(
+        GPT2.from_size("tiny", **TINY_GPT2),
+        config={"train_micro_batch_size_per_gpu": 1, "inference": inf},
+        seed=0)
+
+
+def test_decode_many_greedy_identity_and_invariance():
+    from deepspeed_tpu.inference.driver import synthetic_requests
+    reqs = synthetic_requests(6, vocab=64, seed=3, prompt_min=2,
+                              prompt_max=6, new_min=3, new_max=9,
+                              eos_id=5)
+    prompts = [r.prompt for r in reqs]
+    serial = _serve_engine(1).generate(prompts, max_new_tokens=9,
+                                       eos_id=5)
+    fused_engine = _serve_engine(4)
+    fused = fused_engine.generate(prompts, max_new_tokens=9, eos_id=5)
+    assert serial == fused
+    # batching invariance: solo streams == batched streams at D=4
+    solo = []
+    for p in prompts[:3]:
+        fused_engine.reset()
+        solo.append(fused_engine.generate([p], max_new_tokens=9,
+                                          eos_id=5)[0])
+    fused_engine.reset()
+    assert solo == fused_engine.generate(prompts[:3], max_new_tokens=9,
+                                         eos_id=5)
+
+
+def test_decode_many_non_greedy_falls_back_loudly(caplog):
+    """A custom sampler cannot ride the fused program (the token loop
+    closed on device with argmax) — the scheduler warns once and serves
+    correctly through the per-iteration path."""
+    import logging
+    from deepspeed_tpu.inference.scheduler import (ContinuousScheduler,
+                                                   Request)
+    engine = _serve_engine(4)
+    my_sampler = lambda row: int(np.argmax(row))   # greedy by value,
+    # but not THE greedy_sampler object the fused path keys on
+    sched = ContinuousScheduler(engine, sampler=my_sampler)
+    with caplog.at_level(logging.WARNING):
+        results = sched.run([Request(rid=0, prompt=[1, 2, 3],
+                                     max_new_tokens=5)])
+    assert any("falling back" in r.message for r in caplog.records)
+    assert len(results) == 1 and len(results[0].tokens) == 5
+    ref = _serve_engine(1).generate([[1, 2, 3]], max_new_tokens=5)
+    assert results[0].tokens == ref[0]
+
+
+def test_decode_many_requires_config():
+    engine = _serve_engine(1)
+    with pytest.raises(RuntimeError, match="decode_iters_per_dispatch"):
+        engine.decode_many(np.zeros(3, np.int32), np.zeros(3, bool),
+                           np.full(3, -1, np.int32),
+                           np.full(3, 4, np.int32))
+
+
+def test_serve_stability_clean_with_decode_many():
+    engine = _serve_engine(4)
+    rep = engine.run_stability(prompt_lengths=[1, 4, 8])
+    assert not rep.errors, rep.format()
+    rep = engine.run_graph_lint()
+    assert not rep.errors, rep.format()
+
+
+# =====================================================================
+# resilience × K-block
+# =====================================================================
+
+@pytest.mark.chaos
+def test_preempt_mid_block_drains_at_k_boundary_bitwise(tmpdir):
+    """A preemption request raised MID-BLOCK (while the fused dispatch
+    runs) is honoured at the NEXT K boundary — the documented ≤ K-step
+    drain granularity — with an emergency checkpoint and a BITWISE
+    resume."""
+    K, STEPS = 3, 9
+    cfg = base_config(zero_optimization={"stage": 1},
+                      fp16={"enabled": True, "loss_scale": 128.0},
+                      train_steps_per_dispatch=K)
+
+    def factory():
+        return make_engine(cfg)
+
+    def k_block(engine, _batch):
+        start = engine.global_steps
+        engine.train_many([batch(start + j, dtype=np.float16)
+                           for j in range(K)])
+
+    unbroken = resilience.run_resumable(
+        factory, k_block, steps=STEPS,
+        save_dir=str(tmpdir.join("unbroken")))
+    ref = master_bytes(unbroken)
+
+    sentinel = str(tmpdir.join("preempt"))
+    handler = PreemptionHandler(sentinel_file=sentinel)
+    save_dir = str(tmpdir.join("interrupted"))
+    fired = []
+
+    def k_block_interrupting(engine, _batch):
+        start = engine.global_steps
+        if start == K and not fired:
+            # the request lands while THIS block is about to run — the
+            # drain must wait for the block to complete (global step 2K)
+            fired.append(True)
+            open(sentinel, "w").close()
+        engine.train_many([batch(start + j, dtype=np.float16)
+                           for j in range(K)])
+
+    try:
+        with pytest.raises(SystemExit) as ei:
+            resilience.run_resumable(factory, k_block_interrupting,
+                                     steps=STEPS, save_dir=save_dir,
+                                     handler=handler)
+        assert ei.value.code == RESUME_EXIT_CODE
+        from deepspeed_tpu.checkpoint import find_latest_valid_tag
+        tag = find_latest_valid_tag(save_dir)
+        # drained at the K boundary AFTER the request: step 2K, not K
+        assert tag == f"emergency/global_step{2 * K}"
+        os.remove(sentinel)
+        handler.clear()
+        resumed = resilience.run_resumable(factory, k_block, steps=STEPS,
+                                           save_dir=save_dir,
+                                           handler=handler)
+    finally:
+        handler.uninstall()
+    assert resumed.global_steps == STEPS
+    assert master_bytes(resumed) == ref
+
+
+@pytest.mark.chaos
+def test_watchdog_deadline_scales_with_k():
+    """A healthy K-block runs K× longer than one step: armed with
+    ``deadline_scale=K`` the 1-step deadline must NOT fire, and the
+    near-miss threshold scales with it."""
+    wd = Watchdog(timeout_s=0.3, poll_s=0.02)
+    with wd.armed("k-block", deadline_scale=5):
+        time.sleep(0.9)                  # 3× the base deadline
+    assert not wd.fired
+    assert COUNTERS.watchdog_near_misses == 0   # 0.9 < 0.8 * 1.5
+    with wd.armed("single"):
+        time.sleep(0.6)                  # past the UNSCALED deadline
+        wd.fire_event.wait(timeout=2.0)
+    assert wd.fired
+    with pytest.raises(ValueError, match="deadline_scale"):
+        wd._arm("bad", 0)
+
+
+@pytest.mark.chaos
+def test_train_many_arms_watchdog_scaled():
+    engine = make_engine(base_config(
+        bf16={"enabled": True}, resilience={"watchdog_timeout_s": 60.0}))
+    seen = []
+    real_armed = engine._watchdog.armed
+    engine._watchdog.armed = (
+        lambda label, deadline_scale=1.0:
+        seen.append((label, deadline_scale)) or
+        real_armed(label, deadline_scale=deadline_scale))
+    engine.train_many([batch(0), batch(1), batch(2)])
+    assert ("train_many", 3) in seen
+
+
+# =====================================================================
+# lint/analysis wiring
+# =====================================================================
+
+def test_train_many_rides_graph_lint_gate():
+    """A seeded per-step host callback inside the model is caught by the
+    lint over the K-fused program in error mode — the gate covers the
+    composed program, not just the single step."""
+    engine = make_engine(base_config(graph_lint="error"))
+    engine.train_many([batch(0), batch(1)])      # clean program passes
+
+    class CallbackModel(SimpleModel):
+        def apply(self, params, x, y):
+            import jax.experimental
+            jax.experimental.io_callback(lambda v: None, None,
+                                         x[0, 0], ordered=True)
+            return super().apply(params, x, y)
+
+    bad, _, _, _ = ds.initialize(model=CallbackModel(hidden_dim=HIDDEN),
+                                 config=base_config(graph_lint="error"))
+    with pytest.raises(analysis.GraphLintError):
+        bad.train_many([batch(0), batch(1)])
+
+
+def test_capacity_plan_prices_k_batches():
+    """The K>1 fused capacity plan must price the ACTUAL train_many
+    program — K staged effective batches of residency, not one (the
+    under-pricing would let an over-HBM K config through the memplan
+    error gate)."""
+    K = 8
+    engine = make_engine(base_config(train_steps_per_dispatch=K,
+                                     bf16={"enabled": True}))
+    b = batch(0)
+    plan_k = engine.plan_capacity(b, train=True, fused=True)
+    plan_1 = engine.plan_capacity(b, train=True, fused=True,
+                                  steps_per_dispatch=1)
+    assert plan_k.programs[0].subject == "train_many"
+    assert plan_1.programs[0].subject == "train_batch"
+    # the plan prices PER-DEVICE bytes: the batch shards over dp
+    local_batch = sum(x.nbytes for x in b) // engine.dp_world_size
+    # at least the K-1 extra staged batches show up in the peak
+    assert plan_k.peak_bytes >= plan_1.peak_bytes \
+        + (K - 1) * local_batch - local_batch
+
+
+def test_dispatch_plan_json_carries_block_model():
+    engine = make_engine(base_config(
+        train_steps_per_dispatch=8,
+        fp16={"enabled": True, "loss_scale": 128.0}))
+    plan = engine.plan_dispatch(batch(0, dtype=np.float16), fused=True)
+    doc = plan.to_json()
+    assert doc["subject"] == "train_many"
+    assert doc["fence_model"]["block_steps"] == 8
+    assert doc["fences_per_step"] == pytest.approx(1 / 8)
+    assert doc["executables"]["programs"][0]["kind"] == "train_many"
+    # the amortized dispatch event prices at 1/K per step
+    ev = {e["label"]: e for e in doc["events"]}
+    assert ev["train_many"]["per_step"] == pytest.approx(1 / 8)
