@@ -186,11 +186,15 @@ def _analyze_serve_config(path: str, cfg: dict, an_cfg, suppress,
                           dispatch: bool = False):
     """Serve-config analysis: build a tiny GPT-2 InferenceEngine on the
     config (gating sections stripped — the CLI dispatches itself) and
-    lint/plan its PREFILL + DECODE programs.  The serving analog of the
-    train-step gate — ``--plan`` adds the capacity table with the
-    persistent KV-cache line, ``--dispatch`` the compile-stability pass
-    (the exactly-two-executables invariant across prompt lengths) and
-    the priced per-iteration host timeline."""
+    lint/plan EVERY serving program — prefill (+ the prefix-reuse tail
+    bucket), decode/decode_many, and with an ``inference.speculative``
+    section the draft prefill + fused draft/verify step (the engine
+    builds the draft from ``speculative.draft_size``).  The serving
+    analog of the train-step gate — ``--plan`` adds the capacity table
+    with the persistent page-pool (and draft) lines, ``--dispatch`` the
+    compile-stability pass (the exactly-N-executables invariant across
+    prompt lengths and reuse offsets) and the priced per-iteration host
+    timeline."""
     from deepspeed_tpu.inference import InferenceEngine
     from deepspeed_tpu.models.gpt2 import GPT2
 

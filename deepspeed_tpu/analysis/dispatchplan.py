@@ -482,25 +482,72 @@ def plan_serve_dispatch(engine,
     vocab = int(getattr(engine.module.config, "vocab_size", 0) or 0)
 
     prefill_args = engine._program_args("prefill")
+    prefill_events = [
+        DispatchEvent("dispatch", "prefill", 1.0,
+                      n_leaves=_n_leaves(prefill_args),
+                      note="one executable per bucket for EVERY prompt "
+                           "length and reuse offset (host-side bucket "
+                           "padding; a prefix hit dispatches the "
+                           "narrower tail bucket when the tail fits)"),
+        DispatchEvent("transfer", "prompt", 1.0,
+                      bytes_per=4 * (engine.prefill_bucket
+                                     + engine.cache_spec.capacity),
+                      note="padded [1, bucket] token ids + the slot's "
+                           "[cap] page-table row map"),
+    ]
+    if int(getattr(engine, "spec_draft_tokens", 0) or 0) > 0:
+        prefill_events.append(DispatchEvent(
+            "dispatch", "draft_prefill", 1.0,
+            n_leaves=_n_leaves(engine._program_args("draft_prefill")),
+            note="the draft model's full-prompt prefill rides every "
+                 "admission (no logits read — no extra fence)"))
+    prefill_events.append(DispatchEvent(
+        "fence", "logits-read", 1.0,
+        bytes_per=4 * vocab, removable=False,
+        note="sampler data dependency: the first generated token's "
+             "distribution — ONE fence per admission even with the "
+             "draft prefill riding along"))
     prefill = DispatchPlan(
         subject="prefill",
-        events=[
-            DispatchEvent("dispatch", "prefill", 1.0,
-                          n_leaves=_n_leaves(prefill_args),
-                          note="one executable for EVERY prompt length "
-                               "(host-side bucket padding)"),
-            DispatchEvent("transfer", "prompt", 1.0,
-                          bytes_per=4 * engine.prefill_bucket,
-                          note="padded [1, bucket] token ids"),
-            DispatchEvent("fence", "logits-read", 1.0,
-                          bytes_per=4 * vocab, removable=False,
-                          note="sampler data dependency: the first "
-                               "generated token's distribution"),
-        ],
+        events=prefill_events,
         fence_model=FenceModel(per_boundary=1),
         profile=profile, executables=pred)
 
     d = int(getattr(engine, "decode_iters_per_dispatch", 1))
+    j = int(getattr(engine, "spec_draft_tokens", 0) or 0)
+    if j > 0:
+        # speculative block: ONE dispatch = J draft steps + verify +
+        # acceptance; up to J+1 tokens per fence.  The amortization is
+        # data-dependent (the accept rate), so the plan prices the
+        # per-ITERATION boundary — one dispatch + one [J+1, slots]
+        # token read — and the telemetry's spec_accept_rate converts it
+        # to per-token cost at runtime.
+        decode = DispatchPlan(
+            subject="decode",
+            events=[
+                DispatchEvent("dispatch", "spec_step", 1.0,
+                              n_leaves=_n_leaves(
+                                  engine._program_args("spec_step")),
+                              note=f"J={j} draft proposals + width-"
+                                   f"{j + 1} target verify fused into "
+                                   f"ONE dispatch (greedy acceptance "
+                                   f"closes on device)"),
+                DispatchEvent("transfer", "tokens+masks", 1.0,
+                              bytes_per=13 * slots
+                              + 8 * slots * engine.cache_spec.capacity,
+                              note="per-slot token + active/eos/budget "
+                                   "vectors + both page-table row maps"),
+                DispatchEvent("fence", "tokens-read", 1.0,
+                              bytes_per=5 * slots * (j + 1),
+                              removable=False,
+                              note=f"[J+1, slots] tokens + emitted "
+                                   f"masks once per speculative "
+                                   f"iteration — up to {j + 1} tokens "
+                                   f"per fence at full acceptance"),
+            ],
+            fence_model=FenceModel(per_boundary=1),
+            profile=profile, executables=pred)
+        return {"prefill": prefill, "decode": decode}
     if d > 1:
         # D-fused decode: one dispatch + one TOKEN read (not logits —
         # the sampler ran on device) per D iterations

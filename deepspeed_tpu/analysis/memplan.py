@@ -474,10 +474,18 @@ class CapacityPlan:
                 f"(zero_stage={pers['zero_stage']})")
             if "kv_cache_bytes" in pers:
                 # serving plans (inference/engine.py) carry the
-                # preallocated KV cache as a persistent line item
+                # preallocated KV page pool as a persistent line item
                 lines.append(
                     f"kv cache: {pers['kv_cache_bytes'] / 2**20:.2f}Mi "
-                    f"preallocated")
+                    f"preallocated (page pool)")
+            if "draft_params_bytes" in pers:
+                # speculative decoding: the draft model's weights and
+                # its (plain, unshared) KV pool ride the budget too
+                lines.append(
+                    f"draft: params "
+                    f"{pers['draft_params_bytes'] / 2**20:.2f}Mi + "
+                    f"kv cache "
+                    f"{pers.get('draft_kv_cache_bytes', 0) / 2**20:.2f}Mi")
         if self.zero3_prefetch_bytes:
             lines.append(
                 f"zero3 prefetch transient: "

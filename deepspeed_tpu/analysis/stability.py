@@ -17,7 +17,7 @@ expensive recent bugs were exactly this class:
   (jax 0.4.x XLA-CPU) — bitwise-restored state stepped to NaN.
 
 This pass makes both classes (and the shape-varying-call-site class that
-would break the inference engine's "exactly two executables" promise)
+would break the inference engine's "exactly N executables" promise)
 build-time findings instead of incidents:
 
 ``stability.unpinned-sharding``   (error)  an engine state leaf whose
@@ -26,7 +26,7 @@ build-time findings instead of incidents:
 ``stability.shape-varying``       (error)  call-site signatures for one
     program kind diverge (shape/dtype/structure), so one logical program
     compiles several executables — defeats the single-executable
-    contract (and the serving engine's exactly-two promise).
+    contract (and the serving engine's exactly-N promise).
 ``stability.donation-cache-quirk`` (error) donated buffers + persistent
     compile cache on a backend whose profile declares
     ``persistent_cache_donation_unsafe`` (the PR 10 class).
@@ -163,7 +163,7 @@ def check_single_executable(kind: str, signatures: Sequence[ProgramSignature],
                             report: R.Report) -> None:
     """Every signature in ``signatures`` must hash to the SAME executable;
     a divergence is a ``stability.shape-varying`` error naming the leaf
-    paths that fork the key (the serving engine's "exactly two
+    paths that fork the key (the serving engine's "exactly N
     executables" promise becomes this check across prompt lengths)."""
     if not signatures:
         return
@@ -376,16 +376,34 @@ def predict_executables(engine, batches: Sequence, train: bool = True,
 
 
 def predict_executables_serve(engine) -> ExecutablePrediction:
-    """The inference engine's promise, as a number: exactly TWO
-    executables (prefill + decode) regardless of prompt lengths, request
-    counts or scheduler decisions.  With
-    ``inference.decode_iters_per_dispatch`` > 1 the decode executable is
-    the D-fused ``decode_many`` — still two."""
-    decode = ("decode_many"
-              if int(getattr(engine, "decode_iters_per_dispatch", 1)) > 1
-              else "decode")
-    return ExecutablePrediction(subject="serve", programs=[
-        ("prefill", "bucket", 1), (decode, "slots", 1)])
+    """The inference engine's promise, as a number: a STATICALLY
+    ENUMERATED executable set over the continuous-greedy serving path,
+    regardless of prompt lengths, request counts or scheduler decisions:
+
+    * ``prefill`` — one per admission bucket: the full bucket, plus the
+      narrow ``prefill_tail`` bucket when prefix reuse is on (a hit's
+      tail re-forward, docs/inference.md "Prefix reuse");
+    * the decode program — ``decode``, or the D-fused ``decode_many``
+      (``inference.decode_iters_per_dispatch`` > 1), or — with a draft
+      model — ``draft_prefill`` + the fused ``spec_step`` (the J-draft +
+      verify dispatch; the per-iteration ``decode`` then only compiles
+      for the static baseline / custom-sampler fallback);
+    The ring-layout ``copy_page`` program is deliberately NOT counted:
+    it compiles only if a wrap-around ever collides with a shared page —
+    an exceptional path, priced by the dispatch plan's note instead of
+    the steady-state executable promise."""
+    programs = [("prefill", "bucket", 1)]
+    if int(getattr(engine, "tail_bucket", 0) or 0) > 0:
+        programs.append(("prefill_tail", "tail bucket", 1))
+    j = int(getattr(engine, "spec_draft_tokens", 0) or 0)
+    if j > 0:
+        programs.append(("draft_prefill", "bucket", 1))
+        programs.append(("spec_step", f"J={j}", 1))
+    elif int(getattr(engine, "decode_iters_per_dispatch", 1)) > 1:
+        programs.append(("decode_many", "slots", 1))
+    else:
+        programs.append(("decode", "slots", 1))
+    return ExecutablePrediction(subject="serve", programs=programs)
 
 
 # ----------------------------------------------------------- engine surface
@@ -455,30 +473,52 @@ def check_engine(engine, batch, fused: bool = True,
 
 def check_inference_engine(engine,
                            prompt_lengths: Sequence[int] = ()) -> R.Report:
-    """The serving stability report: the exactly-two-executables promise
-    checked as an invariant — the CALL-path signature of prefill must be
-    identical for every admissible prompt length (the host-side bucket
-    padding, not the compiler, absorbs the variation) — plus sharding
-    pins on weights/cache and the donation quirk."""
+    """The serving stability report: the exactly-N-executables promise
+    checked as an invariant — each admission bucket's CALL-path
+    signature must be identical for every admissible prompt length AND
+    every reuse start offset (the host-side bucket padding, not the
+    compiler, absorbs the variation: full prefill is ``start=0``, a
+    prefix-hit tail is ``start=reused`` — same executable) — plus
+    sharding pins on weights/caches (draft included) and the donation
+    quirk."""
+    import numpy as np
+
     rep = R.Report(subject="serve-stability")
     check_tree_shardings(engine.mesh, engine.params, engine._param_specs,
                          "params", rep)
     check_tree_shardings(engine.mesh, engine._cache, engine._cache_specs,
                          "kv_cache", rep)
+    if getattr(engine, "draft_params", None) is not None:
+        check_tree_shardings(engine.mesh, engine.draft_params,
+                             engine._draft_specs, "draft_params", rep)
+        check_tree_shardings(engine.mesh, engine._draft_cache,
+                             engine._cache_specs, "draft_kv_cache", rep)
 
-    lengths = list(prompt_lengths) or sorted(
-        {1, max(1, engine.prefill_bucket // 2), engine.prefill_bucket})
-    donate = engine._donate_argnums()
-    sigs = []
-    for n in lengths:
-        padded, length = engine._pad_prompt(list(range(max(1, n))))
-        args = (engine.params, engine._cache["k"], engine._cache["v"],
-                engine._cache["pos"], padded, 0, length)
-        sigs.append(signature_of(
-            args, kind="prefill", donate_argnums=donate,
-            arg_labels=("params", "k", "v", "pos", "tokens", "slot",
-                        "length")))
-    check_single_executable("prefill", sigs, rep)
+    donate = engine._donate_argnums("prefill")
+    buckets = [("prefill", engine.prefill_bucket)]
+    if getattr(engine, "tail_bucket", 0):
+        buckets.append(("prefill_tail", engine.tail_bucket))
+    labels = ("params", "k", "v", "pos", "tokens", "rows", "slot",
+              "start", "n_new")
+    cap = engine.cache_spec.capacity
+    for kind, bucket in buckets:
+        lengths = list(prompt_lengths) or sorted(
+            {1, max(1, bucket // 2), bucket})
+        sigs = []
+        for i, n in enumerate(lengths):
+            padded, length = engine._pad_prompt(
+                list(range(max(1, min(n, bucket)))), bucket)
+            # the reuse start offset varies call to call, exactly like
+            # the length — both must be invisible to the compiler
+            start = np.int32((i * 7) % max(1, cap - bucket + 1))
+            args = (engine.params, engine._cache["k"],
+                    engine._cache["v"], engine._cache["pos"], padded,
+                    np.zeros((1, cap), np.int32), np.int32(0), start,
+                    length)
+            sigs.append(signature_of(
+                args, kind=kind, donate_argnums=donate,
+                arg_labels=labels))
+        check_single_executable(kind, sigs, rep)
     check_donation_cache(donate, rep, subject="prefill/decode",
                          arg_labels=("params", "k", "v", "pos"))
     return rep

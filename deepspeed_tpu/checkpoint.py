@@ -1291,7 +1291,11 @@ def load_params_only(load_dir: str, tag: Optional[str] = None, specs=None,
                      readahead_mb: float = 256.0, io_retries: int = 3):
     """Weights-only restore fast path: just the module tree, streamed
     through the PR 5 parallel reader — the serving cold-start read
-    (deepspeed_tpu/inference/, docs/inference.md).
+    (deepspeed_tpu/inference/, docs/inference.md).  Re-entrant by
+    design: a speculative-decoding engine calls it TWICE per cold start
+    (target weights, then the draft model's checkpoint as a second
+    stream with the draft's own ``specs`` — docs/inference.md
+    "Speculative decoding").
 
     Skips every optimizer/ZeRO partition: the stage-1/2 flat-state
     ``zero_pp_rank_*`` shard records are NEVER opened (regression-pinned

@@ -682,7 +682,9 @@ class DeepSpeedConfig:
                      C.INFERENCE_PREFILL_BUCKET, C.INFERENCE_KV_LAYOUT,
                      C.INFERENCE_PAGE_TOKENS, C.INFERENCE_DTYPE,
                      C.INFERENCE_QUANTIZE,
-                     C.INFERENCE_DECODE_ITERS_PER_DISPATCH}
+                     C.INFERENCE_DECODE_ITERS_PER_DISPATCH,
+                     C.INFERENCE_PREFIX_REUSE, C.INFERENCE_POOL_PAGES,
+                     C.INFERENCE_TAIL_BUCKET, C.INFERENCE_SPECULATIVE}
         if inf is not None and set(inf) - inf_known:
             # a typo'd serving knob would silently serve with defaults —
             # loud, like the resilience section
@@ -749,6 +751,76 @@ class DeepSpeedConfig:
                              C.INFERENCE_DECODE_ITERS_PER_DISPATCH_DEFAULT),
             f"{C.INFERENCE}.{C.INFERENCE_DECODE_ITERS_PER_DISPATCH}",
             "DSTPU_DECODE_ITERS")
+
+        # prefix KV reuse over the refcounted page table + the tail
+        # prefill bucket that makes a hit's FLOP saving real
+        # (docs/inference.md "Prefix reuse")
+        self.inference_prefix_reuse = bool(get_scalar_param(
+            inf, C.INFERENCE_PREFIX_REUSE, C.INFERENCE_PREFIX_REUSE_DEFAULT))
+        self.inference_pool_pages = _inf_int(
+            C.INFERENCE_POOL_PAGES, C.INFERENCE_POOL_PAGES_DEFAULT)
+        if self.inference_pool_pages < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_POOL_PAGES} must be >= 0 "
+                f"(0 = slots * pages_per_slot, no overcommit)")
+        self.inference_tail_bucket = _inf_int(
+            C.INFERENCE_TAIL_BUCKET, C.INFERENCE_TAIL_BUCKET_DEFAULT)
+        if self.inference_tail_bucket < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_TAIL_BUCKET} must be >= 0 "
+                f"(0 = page_tokens)")
+
+        # speculative decoding: J draft proposals + target verify fused
+        # into one dispatch (docs/inference.md "Speculative decoding")
+        spec = get_scalar_param(inf, C.INFERENCE_SPECULATIVE, None)
+        if spec is not None and not isinstance(spec, Mapping):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE} must be a JSON "
+                f"object, got {spec!r}")
+        spec_known = {C.INFERENCE_SPEC_DRAFT_TOKENS,
+                      C.INFERENCE_SPEC_DRAFT_SIZE,
+                      C.INFERENCE_SPEC_DRAFT_CHECKPOINT,
+                      C.INFERENCE_SPEC_DRAFT_TAG}
+        if spec is not None and set(spec) - spec_known:
+            raise DeepSpeedConfigError(
+                f"unknown {C.INFERENCE}.{C.INFERENCE_SPECULATIVE} key(s) "
+                f"{sorted(set(spec) - spec_known)}; supported: "
+                f"{sorted(spec_known)}")
+        spec = spec or {}
+        try:
+            self.inference_spec_draft_tokens = int(spec.get(
+                C.INFERENCE_SPEC_DRAFT_TOKENS,
+                C.INFERENCE_SPEC_DRAFT_TOKENS_DEFAULT))
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE}."
+                f"{C.INFERENCE_SPEC_DRAFT_TOKENS} must be an integer, got "
+                f"{spec.get(C.INFERENCE_SPEC_DRAFT_TOKENS)!r}")
+        if self.inference_spec_draft_tokens < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE}."
+                f"{C.INFERENCE_SPEC_DRAFT_TOKENS} must be >= 0 (0 = off)")
+        self.inference_spec_draft_size = spec.get(
+            C.INFERENCE_SPEC_DRAFT_SIZE, C.INFERENCE_SPEC_DRAFT_SIZE_DEFAULT)
+        self.inference_spec_draft_checkpoint = spec.get(
+            C.INFERENCE_SPEC_DRAFT_CHECKPOINT,
+            C.INFERENCE_SPEC_DRAFT_CHECKPOINT_DEFAULT)
+        self.inference_spec_draft_tag = spec.get(
+            C.INFERENCE_SPEC_DRAFT_TAG, C.INFERENCE_SPEC_DRAFT_TAG_DEFAULT)
+        if self.inference_spec_draft_tokens > 0:
+            if self.inference_decode_iters_per_dispatch > 1:
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE} and "
+                    f"{C.INFERENCE}."
+                    f"{C.INFERENCE_DECODE_ITERS_PER_DISPATCH} > 1 both "
+                    f"fuse the decode loop — pick one (the speculative "
+                    f"dispatch already emits up to draft_tokens+1 tokens)")
+            if self.inference_kv_layout == "ring":
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE} requires "
+                    f"the paged kv_layout: the multi-position verify "
+                    f"step cannot wrap a ring window mid-block "
+                    f"(docs/inference.md)")
 
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)

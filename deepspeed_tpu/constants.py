@@ -320,6 +320,38 @@ INFERENCE_QUANTIZE_DEFAULT = None
 # DSTPU_DECODE_ITERS overrides ("off"/"1" disables, an integer sets D).
 INFERENCE_DECODE_ITERS_PER_DISPATCH = "decode_iters_per_dispatch"
 INFERENCE_DECODE_ITERS_PER_DISPATCH_DEFAULT = 1
+# prefix KV reuse over the refcounted page table (docs/inference.md
+# "Prefix reuse"): hash page-aligned prompt prefixes, map hits to shared
+# pages, prefill only the tail.  Outputs stay byte-identical to the
+# no-reuse path (same weights + same tokens ⇒ the same page bytes).
+INFERENCE_PREFIX_REUSE = "prefix_reuse"
+INFERENCE_PREFIX_REUSE_DEFAULT = True
+# page-pool size in PAGES; 0 = slots * pages_per_slot (no overcommit).
+# Fewer pages than the worst case is legal — admission refuses (queues)
+# when the pool is exhausted instead of OOMing.
+INFERENCE_POOL_PAGES = "pool_pages"
+INFERENCE_POOL_PAGES_DEFAULT = 0
+# padding bucket of the TAIL prefill program (a prefix hit forwards only
+# the uncached tail; a narrower bucket makes the FLOP saving real);
+# 0 = page_tokens.  Tails longer than the bucket fall back to the full
+# prefill program (same numerics, no saving).
+INFERENCE_TAIL_BUCKET = "tail_bucket"
+INFERENCE_TAIL_BUCKET_DEFAULT = 0
+# speculative decoding (docs/inference.md "Speculative decoding"):
+# draft_tokens = J proposals per fused draft+verify dispatch (0 = off).
+# The draft model comes from draft_size (a models/gpt2.py GPT2_SIZES
+# key, built on the target's vocab/seq) or the InferenceEngine
+# draft_model= argument; draft_checkpoint/draft_tag stream its weights
+# through a second checkpoint.load_params_only pass.
+INFERENCE_SPECULATIVE = "speculative"
+INFERENCE_SPEC_DRAFT_TOKENS = "draft_tokens"
+INFERENCE_SPEC_DRAFT_TOKENS_DEFAULT = 0
+INFERENCE_SPEC_DRAFT_SIZE = "draft_size"
+INFERENCE_SPEC_DRAFT_SIZE_DEFAULT = None
+INFERENCE_SPEC_DRAFT_CHECKPOINT = "draft_checkpoint"
+INFERENCE_SPEC_DRAFT_CHECKPOINT_DEFAULT = None
+INFERENCE_SPEC_DRAFT_TAG = "draft_tag"
+INFERENCE_SPEC_DRAFT_TAG_DEFAULT = None
 
 #############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming

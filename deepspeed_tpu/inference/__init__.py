@@ -3,11 +3,12 @@
 Checkpoint → tokens: load any training checkpoint through the
 weights-only fast path (``checkpoint.load_params_only`` over the PR 5
 parallel streaming reader), serve GPT-2-family models with a
-preallocated KV cache (paged/ring layouts sized by the capacity
-planner), a prefill/decode compiled-program split gated through graph
-lint + memplan like the training step programs, continuous batching
-across concurrent requests, and bf16 or int8-weight-quantized compute.
-See docs/inference.md.
+refcounted KV page pool (paged/ring layouts sized by the capacity
+planner; shared-prefix reuse across requests), a statically enumerated
+compiled-program set gated through graph lint + memplan like the
+training step programs, continuous batching across concurrent
+requests, optional speculative decoding with a small draft model, and
+bf16 or int8-weight-quantized compute.  See docs/inference.md.
 
     from deepspeed_tpu.inference import InferenceEngine
     eng = InferenceEngine(GPT2.from_size("small"), config=cfg,
@@ -19,13 +20,14 @@ from deepspeed_tpu.inference import driver, kvcache, quant  # noqa: F401
 from deepspeed_tpu.inference.driver import (ServeTelemetry,  # noqa: F401
                                             run_serve, synthetic_requests)
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
-from deepspeed_tpu.inference.kvcache import KVCacheSpec  # noqa: F401
+from deepspeed_tpu.inference.kvcache import (KVCacheSpec,  # noqa: F401
+                                             PagePool)
 from deepspeed_tpu.inference.scheduler import (  # noqa: F401
     ContinuousScheduler, Request, RequestResult, StaticScheduler,
     greedy_sampler, latency_summary)
 
 __all__ = [
-    "InferenceEngine", "KVCacheSpec", "ContinuousScheduler",
+    "InferenceEngine", "KVCacheSpec", "PagePool", "ContinuousScheduler",
     "StaticScheduler", "Request", "RequestResult", "greedy_sampler",
     "latency_summary", "ServeTelemetry", "run_serve",
     "synthetic_requests", "driver", "kvcache", "quant",

@@ -134,6 +134,13 @@ class ServeTelemetry:
             "ttft_p99_ms": percentile(ttft, 99),
             "itl_p50_ms": percentile(itl, 50),
             "itl_p99_ms": percentile(itl, 99),
+            # ---- v2: prefix reuse + speculative decoding (cumulative
+            # over the scheduler's lifetime, like `evicted`)
+            "prefix_hits": int(getattr(sched, "prefix_hits", 0)),
+            "prefix_tokens_reused": int(getattr(sched,
+                                                "prefix_tokens_reused", 0)),
+            "spec_proposed": int(getattr(sched, "spec_proposed", 0)),
+            "spec_accepted": int(getattr(sched, "spec_accepted", 0)),
             "counters": COUNTERS.as_dict(),
         }
         self._emit(event)
@@ -162,6 +169,7 @@ def run_serve(engine, requests, *, jsonl_path: Optional[str] = None,
     tel.close()
     summary = latency_summary(results, elapsed,
                               n_chips=len(engine.mesh.devices.flat))
+    prompt_tokens = sum(r.prompt_len for r in results)
     summary.update({
         "decode_iters": sched.decode_iters,
         "admitted": sched.admitted,
@@ -172,5 +180,27 @@ def run_serve(engine, requests, *, jsonl_path: Optional[str] = None,
         "mp": engine.mp_world_size,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
+        # prefix reuse: hit rate over admissions, prompt tokens whose
+        # prefill was served from shared pages instead of recomputed
+        "prefix_hit_rate": (round(sched.prefix_hits
+                                  / sched.admitted, 4)
+                            if sched.admitted else None),
+        "prefill_tokens_saved": sched.prefix_tokens_reused,
+        "prefill_tokens_total": prompt_tokens,
+        "admission_refusals": sched.admission_refusals,
+        # speculative decoding: accepted draft proposals / proposed
+        "spec_accept_rate": (round(sched.spec_accepted
+                                   / sched.spec_proposed, 4)
+                             if sched.spec_proposed else None),
+        "spec_proposed": sched.spec_proposed,
+        "spec_accepted": sched.spec_accepted,
+        "draft_params": (_count_tree_params(engine.draft_params)
+                         if engine.draft_params is not None else None),
     })
     return {"results": results, "summary": summary}
+
+
+def _count_tree_params(tree) -> int:
+    import jax as _jax
+    leaves = _jax.tree_util.tree_leaves(tree)
+    return int(sum(np.asarray(l).size for l in leaves))

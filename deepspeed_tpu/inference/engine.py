@@ -4,22 +4,37 @@
 stage-3 shard-native) through :func:`checkpoint.load_params_only` — the
 weights-only fast path over the PR 5 parallel streaming reader — places
 the weights on a tensor-parallel serving mesh (optionally int8-quantized
-at load, inference/quant.py), sizes a preallocated KV cache against the
-active :class:`~deepspeed_tpu.analysis.profiles.BackendProfile`
-(inference/kvcache.py), and compiles exactly TWO programs:
+at load, inference/quant.py), sizes a refcounted KV PAGE POOL against
+the active :class:`~deepspeed_tpu.analysis.profiles.BackendProfile`
+(inference/kvcache.py), and compiles a small, STATICALLY ENUMERATED
+program set:
 
-* **prefill** — full-prompt forward for ONE request into a chosen cache
-  slot (fixed prompt bucket, so one executable serves every prompt), and
-* **decode**  — one incremental token step across ALL slots at once
-  (per-slot positions, EOS-agnostic — the scheduler owns eviction).
+* **prefill** — the extend program over the page pool for ONE request:
+  full-prompt forward at ``start=0``, or — after a prefix-cache hit —
+  just the un-cached TAIL at ``start=reused`` (same executable; a
+  narrower ``prefill_tail`` bucket exists so short tails also pay fewer
+  FLOPs).  One executable per bucket, for every prompt length.
+* **decode** — one incremental token step across ALL slots at once
+  (per-slot positions, EOS-agnostic — the scheduler owns eviction), or
+  the D-fused ``decode_many`` (PR 12).
+* **spec_step** — with a draft model configured, ONE dispatch fusing J
+  greedy draft iterations + a width-(J+1) target VERIFY (the extend
+  path again: the target forward over draft positions IS the prefill
+  attention) + on-device longest-agreeing-prefix acceptance — outputs
+  are token-identical to target-only greedy decode by construction
+  (docs/inference.md "Speculative decoding").
+* **draft_prefill** — the draft model's prompt prefill at admission
+  (second ``load_params_only`` stream for its weights).
+* **copy_page** — ring-layout copy-on-write of a shared page (built
+  only when the ring layout and prefix reuse can collide).
 
-Both programs are gated through graph lint and the capacity planner at
+Every program is gated through graph lint and the capacity planner at
 build, exactly like the training step programs (``graph_lint`` /
-``analysis`` config sections; error mode raises at build).  The
-cold-start path is the PR 5 machinery: the persistent compile cache is
-enabled before either program traces, restore latency and cache
-hit/miss counters land in the serve startup event
-(``dstpu.telemetry.startup``) just as they do for training (PR 9).
+``analysis`` config sections; error mode raises at build), and the
+compile-stability pass re-pins the "exactly N executables" promise at
+the new N.  The cold-start path is the PR 5 machinery: the persistent
+compile cache is enabled before any program traces, restore latency and
+cache hit/miss counters land in the serve startup event.
 
 Scale-out model: ONE engine = one model replica (the mesh is the
 model-parallel group).  Data parallelism in serving is engine replicas
@@ -70,7 +85,8 @@ class InferenceEngine:
 
     def __init__(self, model, config=None, mesh=None, params=None,
                  checkpoint_dir: Optional[str] = None,
-                 tag: Optional[str] = None, seed: int = 0):
+                 tag: Optional[str] = None, seed: int = 0,
+                 draft_model=None, draft_params=None):
         if model is None:
             raise ValueError("InferenceEngine: model is required")
         self.module = model
@@ -93,8 +109,8 @@ class InferenceEngine:
         self.config = DeepSpeedConfig(cfg_src, dp_world_size=1)
 
         # persistent compile cache BEFORE any program traces — a serving
-        # replica relaunch reuses the prior attempt's prefill/decode
-        # executables (the PR 5 cold-start machinery)
+        # replica relaunch reuses the prior attempt's executables
+        # (the PR 5 cold-start machinery)
         from deepspeed_tpu.utils import compile_cache as _compile_cache
         self.compile_cache_dir = _compile_cache.enable_from_config(
             self.config)
@@ -155,7 +171,7 @@ class InferenceEngine:
         self.params = self._place(host, specs)
         self.weight_bytes = self._per_device_bytes(self.params, specs)
 
-        # ---- KV cache sized against the active backend profile ----
+        # ---- KV page pool sized against the active backend profile ----
         from deepspeed_tpu.analysis import profiles as prof_mod
         # the EXPLICITLY chosen profile (analysis.profile) sizes budgets;
         # the running backend's profile only shapes the memory model —
@@ -182,6 +198,7 @@ class InferenceEngine:
             max_tokens=max_tokens, dtype=self.compute_dtype,
             layout=self.config.inference_kv_layout,
             page_tokens=self.config.inference_page_tokens,
+            pool_pages=self.config.inference_pool_pages,
             hbm_bytes=(self._explicit_profile.hbm_bytes
                        if self._explicit_profile is not None else None),
             weight_bytes=self.weight_bytes)
@@ -204,25 +221,61 @@ class InferenceEngine:
             raise DeepSpeedConfigError(
                 f"inference.prefill_bucket ({self.prefill_bucket}) exceeds "
                 f"the model's max_seq_len ({max_seq})")
+
+        # ---- prefix reuse: the page table + the narrow tail bucket ----
+        self.prefix_reuse = bool(self.config.inference_prefix_reuse)
+        tail = int(self.config.inference_tail_bucket
+                   or self.cache_spec.page_tokens)
+        # the tail program only exists when it is actually narrower
+        self.tail_bucket = (min(tail, self.prefill_bucket)
+                            if self.prefix_reuse
+                            and min(tail, self.prefill_bucket)
+                            < self.prefill_bucket else 0)
+        self.pool = kvcache.PagePool(self.cache_spec)
+        self._host_pos = np.zeros((self.cache_spec.slots,), np.int64)
         self._cache_specs = kvcache.cache_partition_specs()
         self._cache = self._place(kvcache.init_cache(self.cache_spec),
                                   self._cache_specs)
 
-        # ---- the two compiled programs, lint- and memplan-gated ----
+        # ---- speculative decoding: the draft model + its plain cache ----
+        self.spec_draft_tokens = int(
+            self.config.inference_spec_draft_tokens)
+        self.draft_model = None
+        self.draft_params = None
+        self.draft_weight_bytes = 0
+        self.draft_cache_spec = None
+        self._draft_cache = None
+        self._draft_rows = None
+        if self.spec_draft_tokens > 0:
+            self._init_draft(draft_model, draft_params, seed)
+
+        # ---- the compiled programs, lint- and memplan-gated ----
         # (with decode_iters_per_dispatch > 1 the decode program is the
-        # D-fused decode_many: still exactly TWO executables — the
-        # serial decode builder stays available as the non-greedy
-        # sampler fallback but only compiles if actually dispatched)
+        # D-fused decode_many; with a draft model the greedy path runs
+        # spec_step.  The serial decode builder stays available as the
+        # non-greedy sampler / static-baseline fallback but only
+        # compiles if actually dispatched.)
         self.decode_iters_per_dispatch = int(
             self.config.inference_decode_iters_per_dispatch)
         self._live_flag = jax.device_put(
             jnp.ones((), jnp.int32),
             NamedSharding(self.mesh, P()))
-        self._prefill_fn = self._build_prefill()
+        self._prefill_fn = self._build_admit(self.prefill_bucket)
+        self._prefill_tail_fn = (self._build_admit(self.tail_bucket)
+                                 if self.tail_bucket else None)
         self._decode_fn = self._build_decode()
         self._decode_many_fn = (
             self._build_decode_many(self.decode_iters_per_dispatch)
             if self.decode_iters_per_dispatch > 1 else None)
+        self._draft_prefill_fn = None
+        self._spec_fn = None
+        if self.spec_draft_tokens > 0:
+            self._draft_prefill_fn = self._build_admit(
+                self.prefill_bucket, draft=True)
+            self._spec_fn = self._build_spec(self.spec_draft_tokens)
+        self._copy_page_fn = (self._build_copy_page()
+                              if self.cache_spec.ring and self.prefix_reuse
+                              else None)
         self._warned_fused_fallback = False
         self._gate_programs()
 
@@ -263,65 +316,159 @@ class InferenceEngine:
             total += n
         return total
 
-    def _donate_argnums(self):
-        """Cache buffers (k, v, pos) are donated in both programs — the
-        single source the builders AND the capacity planner read.  XLA-CPU
+    def _init_draft(self, draft_model, draft_params, seed: int):
+        """Resolve the speculative draft: a SMALL engine-protocol LM
+        sharing the target's token space, its weights streamed through a
+        SECOND ``load_params_only`` pass (or built from
+        ``speculative.draft_size``), plus a plain per-slot KV pool
+        (identity page table — the draft never shares pages; its cache
+        is small).  The draft is never quantized: it is already the
+        cheap model, and its proposals only gate acceptance — the
+        emitted tokens always come from the target verify."""
+        cfg = self.config
+        if draft_model is None:
+            size = cfg.inference_spec_draft_size
+            if not size:
+                raise DeepSpeedConfigError(
+                    "inference.speculative.draft_tokens > 0 needs a draft "
+                    "model: pass draft_model= or set "
+                    "inference.speculative.draft_size (docs/inference.md)")
+            from deepspeed_tpu.models.gpt2 import GPT2
+            tgt = self.module.config
+            draft_model = GPT2.from_size(
+                size, vocab_size=tgt.vocab_size,
+                max_seq_len=tgt.max_seq_len)
+        self.draft_model = draft_model
+        validate_fn = getattr(draft_model, "validate", None)
+        if validate_fn is not None:
+            validate_fn(self.mp_world_size)
+        dvocab = getattr(draft_model.config, "vocab_size", None)
+        tvocab = getattr(self.module.config, "vocab_size", None)
+        if dvocab != tvocab:
+            raise DeepSpeedConfigError(
+                f"draft model vocab ({dvocab}) must equal the target's "
+                f"({tvocab}) — speculative acceptance compares token ids")
+        dspecs = draft_model.partition_specs()
+        if draft_params is not None:
+            dhost = jax.tree_util.tree_map(
+                lambda l: np.asarray(l, self._np_dtype(l)), draft_params)
+        elif cfg.inference_spec_draft_checkpoint:
+            t0 = time.perf_counter()
+            loaded = checkpoint.load_params_only(
+                cfg.inference_spec_draft_checkpoint,
+                tag=cfg.inference_spec_draft_tag, specs=dspecs,
+                dtype=self.compute_dtype,
+                threads=cfg.checkpoint_restore_threads,
+                readahead_mb=cfg.checkpoint_restore_readahead_mb,
+                io_retries=cfg.resilience_io_retries)
+            if loaded is None:
+                raise FileNotFoundError(
+                    f"no valid draft checkpoint under "
+                    f"{cfg.inference_spec_draft_checkpoint!r}")
+            _, dhost = loaded
+            logger.info("draft restore in %.2fs (params-only, second "
+                        "stream)", time.perf_counter() - t0)
+        else:
+            dhost = jax.tree_util.tree_map(
+                lambda l: np.asarray(l, self._np_dtype(l)),
+                draft_model.init_params(jax.random.PRNGKey(seed + 1)))
+        self._draft_specs = dspecs
+        self.draft_params = self._place(dhost, dspecs)
+        self.draft_weight_bytes = self._per_device_bytes(
+            self.draft_params, dspecs)
+        self.draft_cache_spec = kvcache.spec_from_model(
+            draft_model, self.mp_world_size,
+            slots=self.cache_spec.slots,
+            max_tokens=self.cache_spec.capacity,
+            dtype=self.compute_dtype, layout="paged",
+            page_tokens=self.cache_spec.page_tokens)
+        self._draft_cache = self._place(
+            kvcache.init_cache(self.draft_cache_spec), self._cache_specs)
+        cap = self.draft_cache_spec.capacity
+        self._draft_rows = np.arange(
+            self.cache_spec.slots * cap, dtype=np.int32).reshape(
+                self.cache_spec.slots, cap)[:, :self.cache_spec.capacity]
+
+    def _donate_argnums(self, kind: str = "decode"):
+        """Cache buffers are donated in every program — the single
+        source the builders AND the capacity planner read.  XLA-CPU
         cannot donate (it would warn per program), so donation is
         accelerator-only; the planner models whatever this returns."""
-        return (1, 2, 3) if jax.default_backend() != "cpu" else ()
+        if jax.default_backend() == "cpu":
+            return ()
+        if kind == "spec_step":
+            return (1, 2, 3, 5, 6)      # k, v, pos, draft k, draft v
+        if kind == "copy_page":
+            return (0, 1)
+        return (1, 2, 3)                # k, v, pos
 
     # ------------------------------------------------------------ programs
-    def _build_prefill(self):
-        model = self.module
-        bucket = self.prefill_bucket
-        spec = self.cache_spec
+    def _extend_shard_fn(self, draft: bool = False):
+        """The (unjitted) shard_mapped extend program — full prefill,
+        tail prefill, and the speculative verify are all this one
+        body at different (batch, width) shapes."""
+        model = self.draft_model if draft else self.module
+        specs = self._draft_specs if draft else self._param_specs
 
-        def local(params, k, v, pos, tokens, slot, length):
-            # tokens [1, bucket]; slot/length int32 scalars
-            logits, ks, vs = model.apply_prefill(
-                params, tokens, jnp.reshape(length, (1,)))
-            pad = spec.capacity - bucket
-            if pad:
-                ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-                vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-            oh = (jnp.arange(spec.slots, dtype=jnp.int32) == slot)
-            ohc = oh.astype(k.dtype)[None, :, None, None, None]
-            k = k * (1 - ohc) + ks.astype(k.dtype) * ohc
-            v = v * (1 - ohc) + vs.astype(v.dtype) * ohc
-            pos = jnp.where(oh, length, pos)
-            return logits, k, v, pos
-
-        fn = jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=(self._param_specs, self._cache_specs["k"],
-                      self._cache_specs["v"], P(), P(), P(), P()),
-            out_specs=(P(None, MODEL_AXIS), self._cache_specs["k"],
-                       self._cache_specs["v"], P()),
-            check_vma=False)
-        return jax.jit(fn, donate_argnums=self._donate_argnums())
-
-    def _decode_shard_fn(self):
-        """The (unjitted) shard_mapped one-token decode program — shared
-        by ``_build_decode`` (one iteration per dispatch) and
-        ``_build_decode_many`` (D iterations fused per dispatch)."""
-        model = self.module
-        ring = self.cache_spec.ring
-
-        def local(params, k, v, pos, tokens, active):
-            return model.apply_decode(params, tokens, k, v, pos, active,
-                                      ring=ring)
+        def local(params, k, v, pos, tokens, n_new, rows):
+            return model.apply_extend(params, tokens, k, v, pos, n_new,
+                                      rows)
 
         return jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(self._param_specs, self._cache_specs["k"],
-                      self._cache_specs["v"], P(), P(), P()),
+            in_specs=(specs, self._cache_specs["k"],
+                      self._cache_specs["v"], P(), P(), P(), P()),
+            out_specs=(P(None, None, MODEL_AXIS), self._cache_specs["k"],
+                       self._cache_specs["v"]),
+            check_vma=False)
+
+    def _build_admit(self, bucket: int, draft: bool = False):
+        """ONE admission program at a given bucket width: extend a
+        single slot by its (full or tail) prompt and return the last
+        real token's logits row.  ``start`` distinguishes nothing at
+        compile time — full prefill is ``start=0``, a prefix-hit tail is
+        ``start=reused`` — so one executable serves both."""
+        ext = self._extend_shard_fn(draft=draft)
+        n_slots = self.cache_spec.slots
+
+        def admitfn(params, k, v, pos, tokens, rows, slot, start, n_new):
+            logits, k, v = ext(params, k, v,
+                               jnp.reshape(start, (1,)), tokens,
+                               jnp.reshape(n_new, (1,)), rows)
+            oh = (jnp.arange(n_slots, dtype=jnp.int32) == slot)
+            pos = jnp.where(oh, start + n_new, pos)
+            last = jnp.clip(n_new - 1, 0, bucket - 1)
+            lrow = jnp.take_along_axis(
+                logits, jnp.reshape(last, (1, 1, 1)), axis=1)[:, 0]
+            return lrow, k, v, pos
+
+        return jax.jit(admitfn,
+                       donate_argnums=self._donate_argnums("prefill"))
+
+    def _decode_shard_fn(self, draft: bool = False):
+        """The (unjitted) shard_mapped one-token decode program — shared
+        by ``_build_decode`` (one iteration per dispatch),
+        ``_build_decode_many`` (D iterations fused) and the draft chain
+        inside ``_build_spec``."""
+        model = self.draft_model if draft else self.module
+        specs = self._draft_specs if draft else self._param_specs
+        ring = False if draft else self.cache_spec.ring
+
+        def local(params, k, v, pos, tokens, active, rows):
+            return model.apply_decode(params, tokens, k, v, pos, active,
+                                      rows, ring=ring)
+
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(specs, self._cache_specs["k"],
+                      self._cache_specs["v"], P(), P(), P(), P()),
             out_specs=(P(None, MODEL_AXIS), self._cache_specs["k"],
                        self._cache_specs["v"], P()),
             check_vma=False)
 
     def _build_decode(self):
         return jax.jit(self._decode_shard_fn(),
-                       donate_argnums=self._donate_argnums())
+                       donate_argnums=self._donate_argnums("decode"))
 
     def _build_decode_many(self, d):
         """ONE jitted program fusing D decode iterations — the serving
@@ -348,10 +495,11 @@ class InferenceEngine:
         decode_shard = self._decode_shard_fn()
 
         def many(params, k, v, pos, tokens, active, eos_ids, remaining,
-                 live):
+                 rows, live):
             def stepped(ops):
                 k, v, pos, tokens, active = ops
-                return decode_shard(params, k, v, pos, tokens, active)
+                return decode_shard(params, k, v, pos, tokens, active,
+                                    rows)
 
             def untaken(ops):
                 k, v, pos, tokens, active = ops
@@ -380,7 +528,133 @@ class InferenceEngine:
             return (jnp.stack(toks_out), jnp.stack(emitted_out),
                     k, v, pos, active, remaining)
 
-        return jax.jit(many, donate_argnums=self._donate_argnums())
+        return jax.jit(many,
+                       donate_argnums=self._donate_argnums("decode"))
+
+    def _build_spec(self, j: int):
+        """ONE jitted program fusing the whole speculative iteration
+        (docs/inference.md "Speculative decoding"): J greedy draft
+        decode steps (the token feedback closes on device, like
+        ``decode_many``), a width-(J+1) target VERIFY through the extend
+        path (the target forward over the draft positions is exactly
+        the prefill attention), and longest-agreeing-prefix acceptance.
+
+        Exactness by construction: verify row ``i`` is the target's
+        greedy successor of the history ending at fed token ``i``; a
+        draft token is only emitted when it EQUALS that successor, and
+        the first mismatch row still yields the target's own token — so
+        the emitted stream is identical to target-only greedy decode.
+        KV rows written for rejected draft positions are garbage that is
+        never visible: position masking hides them and the next block
+        overwrites each row before its position enters any mask.
+
+        Every sub-program runs inside a ``lax.cond`` on the runtime-true
+        ``live`` input — the PR 12 compilation-isolation trick, so the
+        embedded draft/verify bodies cannot re-fuse away from their
+        standalone numerics."""
+        draft_shard = self._decode_shard_fn(draft=True)
+        verify_shard = self._extend_shard_fn()
+
+        def specstep(params, k, v, pos, dparams, kd, vd, rows, drows,
+                     tokens, active, eos_ids, remaining, live):
+            # ---- J draft proposals (greedy chain on the draft cache)
+            def dstep(ops):
+                kd, vd, dpos, feed = ops
+                out = draft_shard(dparams, kd, vd, dpos, feed, active,
+                                  drows)
+                return out[:3]
+
+            def duntaken(ops):
+                kd, vd, dpos, feed = ops
+                logits = jax.eval_shape(dstep, ops)[0]
+                return jnp.zeros(logits.shape, logits.dtype), kd, vd
+
+            feed = tokens
+            drafts = []
+            # J+1 draft steps: the first J produce the proposals, the
+            # last one only WRITES d_J's K/V — on a fully-accepted
+            # block pos advances J+1 and row pos+J becomes draft
+            # history, so leaving it unwritten would poison every later
+            # draft attention with a zero row (outputs stay exact — the
+            # verify gates — but the accept rate silently decays)
+            for i in range(j + 1):
+                dlogits, kd, vd = jax.lax.cond(
+                    live > 0, dstep, duntaken,
+                    (kd, vd, pos + i, feed))
+                if i < j:
+                    feed = jnp.argmax(dlogits.astype(jnp.float32),
+                                      axis=-1).astype(jnp.int32)
+                    drafts.append(feed)
+
+            # ---- target verify over [t0, d1..dJ] (width J+1)
+            vtokens = jnp.stack([tokens] + drafts, axis=1)   # [slots, J+1]
+            n_new = jnp.where(active, j + 1, 0).astype(jnp.int32)
+
+            def vstep(ops):
+                k, v, vt, nn = ops
+                return verify_shard(params, k, v, pos, vt, nn, rows)
+
+            def vuntaken(ops):
+                k, v, vt, nn = ops
+                logits = jax.eval_shape(vstep, ops)[0]
+                return jnp.zeros(logits.shape, logits.dtype), k, v
+
+            vlogits, k, v = jax.lax.cond(
+                live > 0, vstep, vuntaken, (k, v, vtokens, n_new))
+            g = jnp.argmax(vlogits.astype(jnp.float32),
+                           axis=-1).astype(jnp.int32)        # [slots, J+1]
+
+            # ---- longest-agreeing-prefix acceptance + eos/budget masks
+            blk = active          # still emitting within this block
+            act = active          # request still active after the block
+            toks_out, emitted_out = [], []
+            for i in range(j + 1):
+                tok = g[:, i]
+                emitted = blk
+                remaining = remaining - emitted.astype(jnp.int32)
+                hit_eos = jnp.logical_and(eos_ids >= 0, tok == eos_ids)
+                stop = jnp.logical_and(
+                    emitted, jnp.logical_or(hit_eos, remaining <= 0))
+                act = jnp.logical_and(act, jnp.logical_not(stop))
+                blk = jnp.logical_and(emitted, act)
+                if i < j:
+                    # keep emitting only while the draft agreed with the
+                    # target's greedy choice
+                    blk = jnp.logical_and(blk, drafts[i] == tok)
+                toks_out.append(tok)
+                emitted_out.append(emitted)
+            advanced = sum(e.astype(jnp.int32) for e in emitted_out)
+            pos = pos + advanced
+            return (jnp.stack(toks_out), jnp.stack(emitted_out),
+                    k, v, pos, kd, vd, act, remaining)
+
+        return jax.jit(specstep,
+                       donate_argnums=self._donate_argnums("spec_step"))
+
+    def _build_copy_page(self):
+        """Ring-layout copy-on-write: duplicate one page's rows inside
+        the pool before a wrap-around write would clobber a shared page
+        (kvcache.PagePool.prepare_write decides WHEN; this program is
+        the device-side move — pure row copy, bitwise by definition)."""
+        pt = self.cache_spec.page_tokens
+
+        def local(k, v, src, dst):
+            ks = jax.lax.dynamic_slice_in_dim(k, src * pt, pt, axis=1)
+            vs = jax.lax.dynamic_slice_in_dim(v, src * pt, pt, axis=1)
+            k = jax.lax.dynamic_update_slice_in_dim(k, ks, dst * pt,
+                                                    axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(v, vs, dst * pt,
+                                                    axis=1)
+            return k, v
+
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._cache_specs["k"], self._cache_specs["v"],
+                      P(), P()),
+            out_specs=(self._cache_specs["k"], self._cache_specs["v"]),
+            check_vma=False)
+        return jax.jit(fn,
+                       donate_argnums=self._donate_argnums("copy_page"))
 
     def _program_args(self, kind: str):
         """Example argument tuples for tracing (lint + planner) — shapes
@@ -389,39 +663,60 @@ class InferenceEngine:
         k, v = shapes["k"], shapes["v"]
         pos = shapes["pos"]
         slots = self.cache_spec.slots
-        if kind == "prefill":
+        cap = self.cache_spec.capacity
+        rows1 = jax.ShapeDtypeStruct((1, cap), jnp.int32)
+        rows_all = jax.ShapeDtypeStruct((slots, cap), jnp.int32)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        svec = lambda dt: jax.ShapeDtypeStruct((slots,), dt)
+        if kind in ("prefill", "prefill_tail", "draft_prefill"):
+            bucket = (self.tail_bucket if kind == "prefill_tail"
+                      else self.prefill_bucket)
+            if kind == "draft_prefill":
+                dshapes = kvcache.cache_jax_shapes(self.draft_cache_spec)
+                return (self.draft_params, dshapes["k"], dshapes["v"],
+                        dshapes["pos"],
+                        jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                        rows1, i32, i32, i32)
             return (self.params, k, v, pos,
-                    jax.ShapeDtypeStruct((1, self.prefill_bucket),
-                                         jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32))
+                    jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                    rows1, i32, i32, i32)
         if kind == "decode_many":
-            return (self.params, k, v, pos,
-                    jax.ShapeDtypeStruct((slots,), jnp.int32),
-                    jax.ShapeDtypeStruct((slots,), jnp.bool_),
-                    jax.ShapeDtypeStruct((slots,), jnp.int32),
-                    jax.ShapeDtypeStruct((slots,), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32))
-        return (self.params, k, v, pos,
-                jax.ShapeDtypeStruct((slots,), jnp.int32),
-                jax.ShapeDtypeStruct((slots,), jnp.bool_))
+            return (self.params, k, v, pos, svec(jnp.int32),
+                    svec(jnp.bool_), svec(jnp.int32), svec(jnp.int32),
+                    rows_all, i32)
+        if kind == "spec_step":
+            dshapes = kvcache.cache_jax_shapes(self.draft_cache_spec)
+            return (self.params, k, v, pos, self.draft_params,
+                    dshapes["k"], dshapes["v"], rows_all, rows_all,
+                    svec(jnp.int32), svec(jnp.bool_), svec(jnp.int32),
+                    svec(jnp.int32), i32)
+        if kind == "copy_page":
+            return (k, v, i32, i32)
+        return (self.params, k, v, pos, svec(jnp.int32),
+                svec(jnp.bool_), rows_all)
 
     def _gated_programs(self):
         """(kind, fn) pairs of every program production CAN dispatch.
-        At ``decode_iters_per_dispatch`` > 1 BOTH decode forms are
-        gated: the continuous greedy path runs ``decode_many``, but the
-        StaticScheduler baseline and the custom-sampler fallback still
-        dispatch the per-iteration ``decode`` — a program that can run
-        must not skip the error-mode lint/memplan gates."""
-        out = [("prefill", self._prefill_fn),
-               ("decode", self._decode_fn)]
+        The fused/speculative paths do not REPLACE the per-iteration
+        ``decode`` in the gates: the StaticScheduler baseline and the
+        custom-sampler fallback still dispatch it — a program that can
+        run must not skip the error-mode lint/memplan gates."""
+        out = [("prefill", self._prefill_fn)]
+        if self._prefill_tail_fn is not None:
+            out.append(("prefill_tail", self._prefill_tail_fn))
+        out.append(("decode", self._decode_fn))
         if self._decode_many_fn is not None:
             out.append(("decode_many", self._decode_many_fn))
+        if self._spec_fn is not None:
+            out.append(("draft_prefill", self._draft_prefill_fn))
+            out.append(("spec_step", self._spec_fn))
+        if self._copy_page_fn is not None:
+            out.append(("copy_page", self._copy_page_fn))
         return tuple(out)
 
     def run_graph_lint(self) -> graph_lint.Report:
-        """Jaxpr passes over BOTH serving programs (the CLI/test surface,
-        ignoring ``graph_lint.mode``)."""
+        """Jaxpr passes over EVERY serving program (the CLI/test
+        surface, ignoring ``graph_lint.mode``)."""
         mesh_axes = list(self.mesh.shape.keys())
         rep = graph_lint.Report(subject="serve")
         for kind, fn in self._gated_programs():
@@ -431,31 +726,33 @@ class InferenceEngine:
         return rep.filtered(self.config.graph_lint_suppress)
 
     def run_stability(self, prompt_lengths=()) -> graph_lint.Report:
-        """Compile-stability report: the "exactly two executables"
-        promise as a CHECKED invariant — the prefill call-path signature
-        (via :meth:`_pad_prompt`, the marshalling production uses) must
-        be identical across prompt lengths — plus weight/cache sharding
-        pins and the donation × persistent-cache quirk
-        (docs/analysis.md "Dispatch & compile-stability")."""
+        """Compile-stability report: the "exactly N executables"
+        promise as a CHECKED invariant — each admission bucket's
+        call-path signature (via :meth:`_pad_prompt`, the marshalling
+        production uses) must be identical across prompt lengths AND
+        reuse offsets — plus weight/cache sharding pins and the
+        donation × persistent-cache quirk (docs/analysis.md "Dispatch &
+        compile-stability")."""
         from deepspeed_tpu.analysis import stability as stab
         rep = stab.check_inference_engine(
             self, prompt_lengths=prompt_lengths)
         return rep.filtered(self.config.analysis_suppress)
 
     def predict_executables(self):
-        """:class:`deepspeed_tpu.analysis.ExecutablePrediction` — always
-        exactly 2 (prefill + decode); the contract test pins the measured
-        ``compile_cache_misses`` against it."""
+        """:class:`deepspeed_tpu.analysis.ExecutablePrediction` over the
+        continuous-greedy serving path — the contract test pins the
+        measured ``compile_cache_misses`` against it."""
         from deepspeed_tpu.analysis import stability as stab
         return stab.predict_executables_serve(self)
 
     def plan_dispatch(self, profile=None):
         """Static host timelines of the serving hot path:
         ``{"prefill": DispatchPlan, "decode": DispatchPlan}`` — one
-        dispatch + token staging + the sampler's logits read per
-        iteration, priced via the backend profile's dispatch constants
-        (every logits read is a counted fence, so the prediction is
-        checkable against ``observability.fences.FENCE_COUNT``)."""
+        dispatch (or the spec/fused block) + token staging + the
+        sampler's read per iteration, priced via the backend profile's
+        dispatch constants (every logits/token read is a counted fence,
+        so the prediction is checkable against
+        ``observability.fences.FENCE_COUNT``)."""
         from deepspeed_tpu.analysis import dispatchplan
         from deepspeed_tpu.analysis import profiles as prof_mod
         if profile is None:
@@ -463,8 +760,9 @@ class InferenceEngine:
         return dispatchplan.plan_serve_dispatch(self, profile=profile)
 
     def plan_capacity(self, profile=None, budget_gb=None):
-        """Static capacity plan of the prefill + decode programs plus the
-        persistent weights + KV cache — the serving analog of
+        """Static capacity plan of every serving program plus the
+        persistent weights + KV page pool (and the draft's, when
+        speculative decoding is on) — the serving analog of
         ``DeepSpeedTpuEngine.plan_capacity``."""
         from deepspeed_tpu.analysis import memplan
         from deepspeed_tpu.analysis import profiles as prof_mod
@@ -484,10 +782,11 @@ class InferenceEngine:
         for kind, fn in self._gated_programs():
             programs.append(memplan.analyze_program(
                 fn, self._program_args(kind),
-                donate_argnums=self._donate_argnums(),
+                donate_argnums=self._donate_argnums(kind),
                 subject=kind, profile=profile))
         # same key set the training plan's persistent table prints, plus
-        # the serving-only KV cache line
+        # the serving-only page-pool lines (draft lines only when the
+        # speculative path exists)
         persistent = {
             "params_bytes": self.weight_bytes,
             "optimizer_state_bytes": 0,
@@ -495,6 +794,10 @@ class InferenceEngine:
             "zero_stage": 0,
             "kv_cache_bytes": kvcache.cache_bytes(self.cache_spec),
         }
+        if self.draft_cache_spec is not None:
+            persistent["draft_params_bytes"] = self.draft_weight_bytes
+            persistent["draft_kv_cache_bytes"] = kvcache.cache_bytes(
+                self.draft_cache_spec)
         return memplan.CapacityPlan(programs=programs,
                                     persistent=persistent,
                                     profile=profile,
@@ -524,7 +827,7 @@ class InferenceEngine:
                 rep = plan.to_report(subject="serve")
                 # the stability + dispatch passes ride the same analysis
                 # gate (docs/analysis.md "Dispatch & compile-stability"):
-                # the exactly-two-executables invariant, sharding pins,
+                # the exactly-N-executables invariant, sharding pins,
                 # the donation quirk, and the priced host timeline
                 try:
                     rep.extend(self.run_stability())
@@ -567,29 +870,45 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- serving
     def reset(self):
-        """Clear every slot.  The old cache buffers are released BEFORE
-        the fresh zeroed cache is placed — a planner-sized cache fills
-        most of HBM, so holding both copies transiently could OOM the
-        exact configurations the planner approved."""
+        """Clear every slot and the whole prefix index.  The old cache
+        buffers are released BEFORE the fresh zeroed pool is placed — a
+        planner-sized pool fills most of HBM, so holding both copies
+        transiently could OOM the exact configurations the planner
+        approved."""
+        self.pool.reset()
+        self._host_pos[:] = 0
         self._cache = None
         self._cache = self._place(kvcache.init_cache(self.cache_spec),
                                   self._cache_specs)
+        if self.draft_cache_spec is not None:
+            self._draft_cache = None
+            self._draft_cache = self._place(
+                kvcache.init_cache(self.draft_cache_spec),
+                self._cache_specs)
 
-    def _pad_prompt(self, prompt_tokens):
+    def _pad_prompt(self, prompt_tokens, bucket: Optional[int] = None):
         """Host-side bucket padding — THE mechanism behind the
-        one-prefill-executable promise: every admissible prompt length
-        maps to the SAME ``[1, bucket]`` int32 call signature (the
-        compile-stability pass checks this invariant across lengths
-        through this very helper).  Returns ``(padded, length)``."""
+        one-executable-per-bucket promise: every admissible prompt (or
+        tail) length maps to the SAME ``[1, bucket]`` int32 call
+        signature (the compile-stability pass checks this invariant
+        across lengths through this very helper).  Returns ``(padded,
+        length)``."""
+        bucket = self.prefill_bucket if bucket is None else bucket
         toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
-        padded = np.zeros((1, self.prefill_bucket), np.int32)
+        padded = np.zeros((1, bucket), np.int32)
         padded[0, :toks.size] = toks
         return padded, np.int32(toks.size)
 
-    def prefill(self, slot: int, prompt_tokens) -> np.ndarray:
-        """Prefill ``prompt_tokens`` into cache ``slot``; returns the
-        full-vocab logits row of the last prompt token (the first
-        generated token's distribution)."""
+    def admit(self, slot: int, prompt_tokens, max_new_tokens: int,
+              reuse: Optional[bool] = None):
+        """Admission with prefix reuse: allocate the slot's page range
+        (leading pages from the prefix index when the prompt's
+        page-aligned prefix is already resident), prefill ONLY the
+        uncached tail, publish the new full prompt pages, and return
+        ``(last-token logits row, reused_tokens)``.  Returns ``None`` —
+        nothing allocated, nothing dispatched — when the page pool
+        cannot cover the request (the scheduler keeps it queued:
+        capacity-exhausted admission refusal, not an OOM)."""
         toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if toks.size < 1:
             raise ValueError("prefill: empty prompt")
@@ -600,32 +919,111 @@ class InferenceEngine:
                 f"inference.prefill_bucket/max_tokens")
         if not (0 <= int(slot) < self.num_slots):
             raise ValueError(f"slot {slot} outside [0, {self.num_slots})")
-        padded, length = self._pad_prompt(toks)
+        if reuse is None:
+            reuse = self.prefix_reuse
+        self.release(slot)
+        grant = self.pool.admit(slot, toks.tolist(), int(max_new_tokens),
+                                reuse=reuse)
+        if grant is None:
+            return None
+        start = grant.reused_tokens
+        tail = toks[start:]
+        fn, bucket = self._prefill_fn, self.prefill_bucket
+        if (self._prefill_tail_fn is not None
+                and tail.size <= self.tail_bucket):
+            fn, bucket = self._prefill_tail_fn, self.tail_bucket
+        padded, n_new = self._pad_prompt(tail, bucket)
+        rows = self.pool.slot_rows(slot)[None]
         t0 = time.perf_counter()
-        logits, k, v, pos = self._prefill_fn(
+        logits, k, v, pos = fn(
             self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], padded, np.int32(slot), length)
+            self._cache["pos"], padded, rows, np.int32(slot),
+            np.int32(start), n_new)
+        self._cache = {"k": k, "v": v, "pos": pos}
+        if self._draft_prefill_fn is not None:
+            # the draft has no prefix index: its cache prefills the FULL
+            # prompt (cheap by construction — that is what a draft is)
+            dpad, dn = self._pad_prompt(toks, self.prefill_bucket)
+            _, kd, vd, posd = self._draft_prefill_fn(
+                self.draft_params, self._draft_cache["k"],
+                self._draft_cache["v"], self._draft_cache["pos"], dpad,
+                self._draft_rows[slot][None], np.int32(slot),
+                np.int32(0), dn)
+            self._draft_cache = {"k": kd, "v": vd, "pos": posd}
         # the sampler's data dependency: ONE counted fence per admission
         # (observability/fences.py — the dispatch plan predicts exactly
         # this counter, tests/test_dispatch_stability.py)
         out = np.asarray(obs_fences.read_arrays(logits)[0],
                          np.float32)[0]
-        self._cache = {"k": k, "v": v, "pos": pos}
+        if self.prefix_reuse:
+            self.pool.publish(grant)
+        self._host_pos[slot] = toks.size
         if self.first_token_ts is None:
             self.first_token_ts = time.time()
             self.first_dispatch_s = time.perf_counter() - t0
-        return out
+        return out, grant.reused_tokens
+
+    def release(self, slot: int) -> None:
+        """Evict ``slot``: decrement every page refcount (shared pages
+        survive for other slots / the LRU prefix cache)."""
+        self.pool.release(int(slot))
+        self._host_pos[slot] = 0
+
+    def prefill(self, slot: int, prompt_tokens) -> np.ndarray:
+        """Prefill ``prompt_tokens`` into cache ``slot`` WITHOUT prefix
+        reuse — always the full-prompt forward (the decode-exactness
+        oracle's reference semantics, and the no-reuse baseline).
+        Returns the full-vocab logits row of the last prompt token (the
+        first generated token's distribution).
+
+        Allocates the slot's FULL capacity range, so it never fails on
+        the default pool sizing — but on an overcommitted pool
+        (``inference.pool_pages``) with enough neighbours holding pages
+        it can, and raises loudly: this path has no queue to fall back
+        to.  Use :meth:`admit` (which returns ``None`` for the caller
+        to retry) for refusal-tolerant admission."""
+        toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        budget = max(0, self.cache_spec.capacity - toks.size)
+        res = self.admit(slot, toks, budget, reuse=False)
+        if res is None:
+            raise RuntimeError(
+                f"page pool exhausted: prefill needs the slot's full "
+                f"{self.cache_spec.pages_per_slot}-page range but only "
+                f"{self.pool.free_pages} page(s) are allocatable — "
+                f"raise inference.pool_pages or admit() via a scheduler "
+                f"that tolerates refusal (docs/inference.md)")
+        return res[0]
+
+    def _ring_write_barrier(self, active, width: int) -> None:
+        """Before a decode-family dispatch on a RING cache with prefix
+        reuse: make every page the next ``width`` writes will touch
+        exclusively owned (copy-on-write via the ``copy_page`` program)
+        and un-publish own pages whose content is about to diverge."""
+        if self._copy_page_fn is None:
+            return
+        for slot in np.flatnonzero(np.asarray(active, bool)):
+            pos = int(self._host_pos[slot])
+            copies = self.pool.prepare_write(
+                int(slot), range(pos, pos + width))
+            for src, dst in copies:
+                k, v = self._copy_page_fn(
+                    self._cache["k"], self._cache["v"],
+                    np.int32(src), np.int32(dst))
+                self._cache["k"], self._cache["v"] = k, v
 
     def decode(self, tokens, active) -> np.ndarray:
         """One decode iteration over every slot: ``tokens`` int32
         [slots] (this step's input token per slot), ``active`` bool
         [slots].  Returns full-vocab logits [slots, vocab] (inactive
         rows are meaningless); per-slot positions advance by ``active``."""
+        active = np.asarray(active, bool)
+        self._ring_write_barrier(active, 1)
         logits, k, v, pos = self._decode_fn(
             self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], np.asarray(tokens, np.int32),
-            np.asarray(active, bool))
+            self._cache["pos"], np.asarray(tokens, np.int32), active,
+            self.pool.rows())
         self._cache = {"k": k, "v": v, "pos": pos}
+        self._host_pos += active
         # one counted fence per decode iteration (sampler dependency;
         # the dispatch plan's predicted fence counter)
         return np.asarray(obs_fences.read_arrays(logits)[0], np.float32)
@@ -643,28 +1041,62 @@ class InferenceEngine:
             raise RuntimeError(
                 "decode_many needs inference.decode_iters_per_dispatch "
                 "> 1 (the fused decode program was not built)")
+        active = np.asarray(active, bool)
+        self._ring_write_barrier(active, self.decode_iters_per_dispatch)
         toks, emitted, kb, vb, pos, _active, _rem = self._decode_many_fn(
             self.params, self._cache["k"], self._cache["v"],
             self._cache["pos"], np.asarray(tokens, np.int32),
-            np.asarray(active, bool), np.asarray(eos_ids, np.int32),
-            np.asarray(remaining, np.int32), self._live_flag)
+            active, np.asarray(eos_ids, np.int32),
+            np.asarray(remaining, np.int32), self.pool.rows(),
+            self._live_flag)
         self._cache = {"k": kb, "v": vb, "pos": pos}
         # the sampler fence, amortized: one counted read per D-block
         # instead of one per token (dispatch plan prices it at 1/D)
         out = obs_fences.read_arrays(toks, emitted)
-        return np.asarray(out[0]), np.asarray(out[1]).astype(bool)
+        toks = np.asarray(out[0])
+        emitted = np.asarray(out[1]).astype(bool)
+        self._host_pos += emitted.sum(axis=0)
+        return toks, emitted
+
+    def spec_decode(self, tokens, active, eos_ids, remaining):
+        """One speculative iteration in ONE dispatch: J draft proposals
+        + target verify + acceptance (``_build_spec``).  Same calling
+        convention as :meth:`decode_many`; returns ``(tokens [J+1,
+        slots], emitted [J+1, slots])`` where the emitted tokens are
+        token-identical to target-only greedy decode.  ONE counted
+        fence per iteration, covering up to J+1 emitted tokens."""
+        if self._spec_fn is None:
+            raise RuntimeError(
+                "spec_decode needs inference.speculative.draft_tokens "
+                "> 0 (the speculative program was not built)")
+        toks, emitted, k, v, pos, kd, vd, _act, _rem = self._spec_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            self._cache["pos"], self.draft_params,
+            self._draft_cache["k"], self._draft_cache["v"],
+            self.pool.rows(), self._draft_rows,
+            np.asarray(tokens, np.int32), np.asarray(active, bool),
+            np.asarray(eos_ids, np.int32),
+            np.asarray(remaining, np.int32), self._live_flag)
+        self._cache = {"k": k, "v": v, "pos": pos}
+        self._draft_cache = {"k": kd, "v": vd,
+                             "pos": self._draft_cache["pos"]}
+        out = obs_fences.read_arrays(toks, emitted)
+        toks = np.asarray(out[0])
+        emitted = np.asarray(out[1]).astype(bool)
+        self._host_pos += emitted.sum(axis=0)
+        return toks, emitted
 
     def note_fused_decode_fallback(self, why: str) -> None:
         """One-shot warning when a scheduler cannot use the built fused
-        decode (non-greedy sampler): serving silently at 1 iteration per
-        dispatch while the config promises D would hide the regression."""
+        decode / speculative program (non-greedy sampler): serving
+        silently at 1 iteration per dispatch while the config promises
+        a fused path would hide the regression."""
         if not self._warned_fused_fallback:
             self._warned_fused_fallback = True
             logger.warning(
-                "inference: decode_iters_per_dispatch=%d requested but "
+                "inference: a fused decode path was configured but "
                 "%s — falling back to one decode dispatch per iteration "
-                "(docs/inference.md \"Fused decode\")",
-                self.decode_iters_per_dispatch, why)
+                "(docs/inference.md)", why)
 
     def slot_positions(self) -> np.ndarray:
         return np.asarray(self._cache["pos"])

@@ -171,6 +171,12 @@ class ContinuousScheduler:
         self.decode_iters = 0
         self.admitted = 0
         self.evicted = 0
+        # prefix-reuse / speculative telemetry (serve schema v2 columns)
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+        self.admission_refusals = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     # ------------------------------------------------------------- intake
     def submit(self, request: Request, now: Optional[float] = None):
@@ -192,13 +198,25 @@ class ContinuousScheduler:
         eng = self.engine
         admitted_now = 0
         # 1) admission: fill free slots from the queue (every queued
-        # request already passed the submit-time budget checks)
+        # request already passed the submit-time budget checks).  A
+        # prefix-cache hit maps the prompt's page-aligned prefix to
+        # shared pages and prefills only the tail; a page-pool refusal
+        # (capacity exhausted) keeps the request QUEUED — active slots
+        # release pages as they finish, so the refusal is transient
         for i in range(len(self.slots)):
             if not self.queue or self.slots[i] is not None:
                 continue
-            req, t_enq = self.queue.pop(0)
-            logits = eng.prefill(i, req.prompt)
+            req, t_enq = self.queue[0]
+            res = eng.admit(i, req.prompt, req.max_new_tokens)
+            if res is None:
+                self.admission_refusals += 1
+                break            # pool exhausted: no later slot differs
+            self.queue.pop(0)
+            logits, reused = res
             now = time.perf_counter()
+            if reused:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += reused
             tok = self.sampler(logits)
             self.slots[i] = _Slot(req, tok, t_enq, now)
             self.admitted += 1
@@ -216,13 +234,56 @@ class ContinuousScheduler:
         tokens_out = admitted_now
         active_idx = [i for i, s in enumerate(self.slots) if s is not None]
         d = int(getattr(eng, "decode_iters_per_dispatch", 1))
+        j = int(getattr(eng, "spec_draft_tokens", 0))
         fused = d > 1
-        if fused and self.sampler is not greedy_sampler:
+        spec = j > 0
+        if (fused or spec) and self.sampler is not greedy_sampler:
             eng.note_fused_decode_fallback(
                 "the scheduler's sampler is not the greedy sampler (the "
                 "fused program closes the token loop with argmax)")
-            fused = False
-        if active_idx and fused:
+            fused = spec = False
+        if active_idx and spec:
+            # speculative iteration: ONE dispatch = J draft proposals +
+            # target verify + acceptance; up to J+1 tokens land per
+            # active slot, token-identical to target-only greedy decode
+            # (docs/inference.md "Speculative decoding")
+            n = len(self.slots)
+            feed = np.zeros((n,), np.int32)
+            active = np.zeros((n,), bool)
+            eos_ids = np.full((n,), -1, np.int32)
+            remaining = np.zeros((n,), np.int32)
+            for i in active_idx:
+                s = self.slots[i]
+                feed[i] = s.last_token
+                active[i] = True
+                if s.req.eos_id is not None:
+                    eos_ids[i] = s.req.eos_id
+                remaining[i] = s.req.max_new_tokens - len(s.generated)
+            toks, emitted = eng.spec_decode(feed, active, eos_ids,
+                                            remaining)
+            now = time.perf_counter()
+            self.decode_iters += 1
+            self.spec_proposed += j * len(active_idx)
+            for it in range(toks.shape[0]):
+                for i in active_idx:
+                    if not emitted[it, i]:
+                        continue
+                    s = self.slots[i]
+                    tok = int(toks[it, i])
+                    s.generated.append(tok)
+                    s.itl.append(now - s.t_last)
+                    s.t_last = now
+                    s.last_token = tok
+                    tokens_out += 1
+                    if it > 0:
+                        # tokens past the first are ACCEPTED draft
+                        # proposals (the first is the target's own)
+                        self.spec_accepted += 1
+            for i in active_idx:
+                s = self.slots[i]
+                if _stops(s.req, s.last_token, len(s.generated)):
+                    self._evict(i)
+        elif active_idx and fused:
             n = len(self.slots)
             feed = np.zeros((n,), np.int32)
             active = np.zeros((n,), bool)
@@ -287,6 +348,9 @@ class ContinuousScheduler:
                   and s.generated[-1] == s.req.eos_id else "length")
         self.slots[slot_idx] = None
         self.evicted += 1
+        # refcount-- on every page the slot mapped: shared pages survive
+        # for their other readers / the LRU prefix cache
+        self.engine.release(slot_idx)
         self.results.append(RequestResult(
             rid=s.req.rid, tokens=list(s.generated), finish_reason=reason,
             ttft_s=s.ttft, itl_s=list(s.itl),

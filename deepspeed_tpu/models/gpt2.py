@@ -131,48 +131,57 @@ class GPT2:
         return (cfg.num_layers, cfg.num_heads // mp_size,
                 cfg.hidden_size // cfg.num_heads)
 
-    def apply_prefill(self, params, tokens, length):
-        """Prefill forward (runs inside shard_map, like ``apply``).
+    def apply_extend(self, params, tokens, k, v, pos, n_new, rows):
+        """A block of NEW tokens forwarded against the KV page pool —
+        prefill (``pos=0``), tail prefill over a reused prefix
+        (``pos=reused``), and the speculative VERIFY step are all this
+        one program shape (runs inside shard_map, like ``apply``).
 
-        tokens: int32 [B, P] left-aligned prompts padded to the prefill
-        bucket; length: int32 [B] real token counts.  Returns the
-        last-real-token logits [B, vocab/mp] (vocab-sharded) plus the
-        stacked per-layer K/V [L, B, P, n_local, d] for the cache.  Pad
-        rows' K/V are garbage but harmless: decode masks strictly by
-        position and overwrites each row before it becomes visible."""
+        tokens: int32 [B, E] left-aligned new tokens (``n_new[b]``
+        real); k/v: [L, R, n_local, d] flat page pools; pos: int32 [B]
+        absolute position of each slot's first new token; rows: int32
+        [B, cap] page-table row map.  Returns ``(logits [B, E,
+        vocab/mp], k', v')`` — logits for EVERY block position (the
+        verify step consumes all of them; prefill takes row
+        ``n_new-1``); pad positions' logits are garbage the caller
+        masks.  Pad K/V writes are dropped, never visible."""
         cfg = self.config
-        B, P = tokens.shape
+        B, E = tokens.shape
         x = L.vocab_parallel_embedding(tokens, params["wte"])
-        x = x + L.seq_shard_positions(params["wpe"], P).astype(x.dtype)[None]
-        attn_mask = (jnp.arange(P, dtype=jnp.int32)[None, :]
-                     < length[:, None]).astype(jnp.float32)
-        x, ks, vs = T.stack_prefill(x, params["blocks"], cfg,
-                                    attn_mask=attn_mask,
-                                    cache_dtype=x.dtype)
+        wpe = params["wpe"]
+        positions = jnp.clip(
+            pos[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :],
+            0, wpe.shape[0] - 1)
+        x = x + jnp.take(wpe, positions, axis=0).astype(x.dtype)
+        x, k, v = T.stack_extend(x, params["blocks"], cfg, k, v, rows,
+                                 pos, n_new)
         x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
-        h_last = jnp.take_along_axis(
-            x, jnp.clip(length - 1, 0, P - 1)[:, None, None], axis=1)[:, 0]
-        return L.vocab_parallel_logits(h_last, params["wte"]), ks, vs
+        logits = L.vocab_parallel_logits(x, params["wte"])
+        return logits, k, v
 
-    def apply_decode(self, params, tokens, k, v, pos, active,
+    def apply_decode(self, params, tokens, k, v, pos, active, rows,
                      ring: bool = False):
         """One incremental decode step (runs inside shard_map).
 
         tokens: int32 [B] (this step's input token per slot); k/v:
-        [L, B, cap, n_local, d] caches; pos: int32 [B] absolute position
-        the new token occupies; active: bool [B] (inactive slots keep
-        their state — their logits are computed but meaningless).
-        Returns ``(logits [B, vocab/mp], k', v', pos')`` with
+        [L, R, n_local, d] flat page pools; pos: int32 [B] absolute
+        position the new token occupies; active: bool [B] (inactive
+        slots write nothing and keep their state — their logits are
+        computed but meaningless); rows: int32 [B, cap] page-table row
+        map.  Returns ``(logits [B, vocab/mp], k', v', pos')`` with
         ``pos' = pos + active``."""
         cfg = self.config
-        cap = k.shape[2]
+        cap = rows.shape[1]
+        R = k.shape[1]
         write_idx = (pos % cap) if ring else jnp.clip(pos, 0, cap - 1)
+        wrow = jnp.take_along_axis(rows, write_idx[:, None], axis=1)[:, 0]
+        wrow = jnp.where(active, wrow, R)     # inactive → drop row
         x = L.vocab_parallel_embedding(tokens[:, None], params["wte"])
         wpe = params["wpe"]
         prow = jnp.take(wpe, jnp.clip(pos, 0, wpe.shape[0] - 1), axis=0)
         x = x + prow[:, None].astype(x.dtype)
         x, k, v = T.stack_decode(x, params["blocks"], cfg, k, v, pos,
-                                 write_idx, ring=ring)
+                                 rows, wrow, ring=ring)
         x = L.layer_norm(x, params["lnf_s"], params["lnf_b"], cfg.ln_eps)
         logits = L.vocab_parallel_logits(x[:, 0], params["wte"])
         return logits, k, v, pos + active.astype(jnp.int32)

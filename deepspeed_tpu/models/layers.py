@@ -400,17 +400,24 @@ def matmul_dequant(x, wq):
     return y * wq["s"].reshape(-1).astype(y.dtype)
 
 
-def write_kv_cache(cache, new, idx):
-    """Functional per-slot row write: ``cache[b, idx[b]] = new[b]``.
+def gather_kv_rows(pool, rows):
+    """Per-slot view of the flat KV page pool: ``pool`` [R, n, d],
+    ``rows`` int32 [B, cap] (the host-resolved page-table row map) →
+    [B, cap, n, d].  Shared pages appear in several slots' views at
+    zero copy cost — the gather is the read attention does anyway."""
+    return jnp.take(pool, rows, axis=0, mode="clip")
 
-    cache: [B, cap, n, d]; new: [B, n, d]; idx: int32 [B].  Expressed as a
-    one-hot blend so per-slot positions (continuous batching: every slot
-    is at its own decode offset) stay a single vectorized XLA op — a
-    gather/scatter would serialize on TPU."""
-    cap = cache.shape[1]
-    oh = (jnp.arange(cap, dtype=jnp.int32)[None, :]
-          == idx[:, None]).astype(cache.dtype)[..., None, None]
-    return cache * (1 - oh) + new.astype(cache.dtype)[:, None] * oh
+
+def scatter_kv_rows(pool, new, rows):
+    """Write ``new`` token rows into the flat pool: ``pool`` [R, n, d],
+    ``new`` [..., n, d] with ``rows`` int32 matching its leading dims.
+    Rows ``>= R`` are DROPPED — the masked-write convention (padding /
+    inactive slots aim at the out-of-range drop row).  In-bounds rows
+    are exclusively owned by their writer (the page table's refcount
+    discipline), so duplicates only ever occur among dropped writes."""
+    n, d = new.shape[-2], new.shape[-1]
+    flat = new.reshape(-1, n, d).astype(pool.dtype)
+    return pool.at[rows.reshape(-1)].set(flat, mode="drop")
 
 
 def cached_attention(q, k_cache, v_cache, pos, ring: bool = False):
@@ -437,53 +444,102 @@ def cached_attention(q, k_cache, v_cache, pos, ring: bool = False):
     return jnp.einsum("bnt,btnd->bnd", probs, v_cache.astype(q.dtype))
 
 
-def prefill_multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local,
-                                proj_b, *, n_heads_global, causal,
-                                attn_mask=None, axis=MODEL_AXIS):
-    """``multihead_attention`` that ALSO returns this layer's K/V — the
-    prefill half of the KV-cached serving path.  Same projection and
-    ``core_attention`` math as the training forward (the decode-path
-    exactness oracle depends on it); sequence parallelism is not a
-    serving layout, so the seq axis must be unsharded here."""
-    if axis_size_or_1(SEQ_AXIS) > 1:
-        raise ValueError(
-            "prefill_multihead_attention: KV-cached serving does not "
-            "compose with context parallelism (shard requests over "
-            "engine replicas instead)")
-    B, T, h = x.shape
-    d = h // n_heads_global
-    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)
-    n_local = qkv.shape[-1] // (3 * d)
-    qkv = qkv.reshape(B, T, n_local, 3, d)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    ctx = core_attention(q, k, v, causal=causal, attn_mask=attn_mask)
-    ctx = ctx.reshape(B, T, n_local * d)
-    return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis), k, v
+def extend_attention(q, k_view, v_view, start):
+    """Multi-query attention of a block of NEW tokens against a per-slot
+    KV view that already contains their rows.
+
+    q: [B, E, n, d] (queries for E new tokens, slot b's first at
+    absolute position ``start[b]``); views: [B, cap, n, d] (gathered
+    AFTER this block's K/V rows were scattered in).  Query e attends
+    rows ``t <= start + e`` — earlier new tokens included, later ones
+    masked out, exactly causal.  Numerics mirror :func:`cached_attention`
+    (fp32 score accumulation and softmax, probs cast to compute dtype)
+    so a tail prefill over reused pages stays within dtype tolerance of
+    the full-prompt forward.  The caller guarantees no ring wrap inside
+    the block (``start + E <= cap`` — admission starts slots fresh and
+    the schedulers bound prompt length by the bucket)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bend,btnd->bent", q, k_view,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    cap = k_view.shape[1]
+    e_pos = (start[:, None]
+             + jnp.arange(q.shape[1], dtype=jnp.int32)[None, :])  # [B, E]
+    valid = (jnp.arange(cap, dtype=jnp.int32)[None, None, :]
+             <= e_pos[:, :, None])                               # [B, E, t]
+    scores = jnp.where(valid[:, :, None, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bent,btnd->bend", probs, v_view.astype(q.dtype))
 
 
 def decode_multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local,
-                               proj_b, k_cache, v_cache, pos, write_idx,
-                               *, n_heads_global, ring: bool = False,
-                               axis=MODEL_AXIS):
-    """One-token attention step against the KV cache.
+                               proj_b, k_pool, v_pool, pos, rows,
+                               write_rows, *, n_heads_global,
+                               ring: bool = False, axis=MODEL_AXIS):
+    """One-token attention step against the KV page pool.
 
-    x: [B, 1, h]; caches: [B, cap, n_local, d]; pos/write_idx: int32 [B]
-    (absolute position and cache row — they differ only in the ring
-    layout, where the row wraps).  Writes this step's K/V, attends the
-    query against the updated cache, and returns ``(out [B, 1, h],
-    k_cache', v_cache')``."""
+    x: [B, 1, h]; pools: [R, n_local, d] flat rows; pos: int32 [B]
+    (absolute position the new token occupies); rows: int32 [B, cap]
+    (the slot's page-table row map); write_rows: int32 [B] (this
+    step's flat target row, ``>= R`` = masked write).  Scatters this
+    step's K/V, gathers the per-slot view, attends, and returns
+    ``(out [B, 1, h], k_pool', v_pool')``."""
     B, _, h = x.shape
     d = h // n_heads_global
     qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)  # [B,1,3h/mp]
     n_local = qkv.shape[-1] // (3 * d)
     qkv = qkv.reshape(B, n_local, 3, d)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    k_cache = write_kv_cache(k_cache, k, write_idx)
-    v_cache = write_kv_cache(v_cache, v, write_idx)
-    ctx = cached_attention(q, k_cache, v_cache, pos, ring=ring)
+    k_pool = scatter_kv_rows(k_pool, k[:, None], write_rows[:, None])
+    v_pool = scatter_kv_rows(v_pool, v[:, None], write_rows[:, None])
+    k_view = gather_kv_rows(k_pool, rows)
+    v_view = gather_kv_rows(v_pool, rows)
+    ctx = cached_attention(q, k_view, v_view, pos, ring=ring)
     ctx = ctx.reshape(B, 1, n_local * d)
     out = row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis)
-    return out, k_cache, v_cache
+    return out, k_pool, v_pool
+
+
+def extend_multihead_attention(x, qkv_w_local, qkv_b_local, proj_w_local,
+                               proj_b, k_pool, v_pool, rows, start, n_new,
+                               *, n_heads_global, axis=MODEL_AXIS):
+    """Attention for a BLOCK of new tokens against the KV page pool —
+    the prefill / tail-prefill / speculative-verify path (one program
+    shape serves all three, docs/inference.md).
+
+    x: [B, E, h] (E new tokens per slot, left-aligned, ``n_new[b]``
+    real); pools: [R, n_local, d]; rows: int32 [B, cap]; start: int32
+    [B] (absolute position of each slot's first new token).  Pad
+    positions and positions past the slot's range write to the drop row;
+    their outputs are garbage the caller masks.  Sequence parallelism is
+    not a serving layout, so the seq axis must be unsharded here."""
+    if axis_size_or_1(SEQ_AXIS) > 1:
+        raise ValueError(
+            "extend_multihead_attention: KV-cached serving does not "
+            "compose with context parallelism (shard requests over "
+            "engine replicas instead)")
+    B, E, h = x.shape
+    d = h // n_heads_global
+    qkv = column_parallel_linear(x, qkv_w_local, qkv_b_local)
+    n_local = qkv.shape[-1] // (3 * d)
+    qkv = qkv.reshape(B, E, n_local, 3, d)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    cap = rows.shape[1]
+    R = k_pool.shape[0]
+    idx = (start[:, None]
+           + jnp.arange(E, dtype=jnp.int32)[None, :])            # [B, E]
+    wrows = jnp.take_along_axis(rows, jnp.clip(idx, 0, cap - 1), axis=1)
+    real = ((jnp.arange(E, dtype=jnp.int32)[None, :] < n_new[:, None])
+            & (idx < cap))
+    wrows = jnp.where(real, wrows, R)            # pad/overflow → drop row
+    k_pool = scatter_kv_rows(k_pool, k, wrows)
+    v_pool = scatter_kv_rows(v_pool, v, wrows)
+    k_view = gather_kv_rows(k_pool, rows)
+    v_view = gather_kv_rows(v_pool, rows)
+    ctx = extend_attention(q, k_view, v_view, start)
+    ctx = ctx.reshape(B, E, n_local * d)
+    return row_parallel_linear(ctx, proj_w_local, proj_b, axis=axis), \
+        k_pool, v_pool
 
 
 def attention_plan(T, n, d, causal):

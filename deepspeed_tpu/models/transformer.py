@@ -312,34 +312,14 @@ def scan_layers(body, carry, stacked_params, cfg: TransformerConfig,
 # dtype tolerance of a full-context re-forward — the exactness oracle in
 # tests/test_inference.py depends on this sharing, not on luck.
 
-def block_prefill(x, p, cfg: TransformerConfig, attn_mask=None):
-    """One dense block on the full prompt that also emits its K/V
-    ([B, T, n_local, d] each) for the cache.  Pre-/post-LN mirror
-    ``block_with_ffn``'s dense path exactly."""
-    attn = lambda u: L.prefill_multihead_attention(
-        u, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"],
-        n_heads_global=cfg.num_heads, causal=cfg.causal,
-        attn_mask=attn_mask)
-    ln1 = lambda u: L.layer_norm(u, p["ln1_s"], p["ln1_b"], cfg.ln_eps)
-    ln2 = lambda u: L.layer_norm(u, p["ln2_s"], p["ln2_b"], cfg.ln_eps)
-    if cfg.pre_ln:
-        a, k, v = attn(ln1(x))
-        x = x + a
-        x = x + _mlp(ln2(x), p)
-    else:
-        a, k, v = attn(x)
-        x = ln1(x + a)
-        x = ln2(x + _mlp(x, p))
-    return x, k, v
-
-
-def block_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos,
-                 write_idx, ring: bool = False):
-    """One dense block on a single-token slice x [B, 1, h] against the
-    per-slot KV cache; returns ``(x, k_cache', v_cache')``."""
+def block_decode(x, p, cfg: TransformerConfig, k_pool, v_pool, pos,
+                 rows, write_rows, ring: bool = False):
+    """One dense block on a single-token slice x [B, 1, h] against this
+    layer's KV page pool ([R, n_local, d] flat rows, read through the
+    ``rows`` page-table map); returns ``(x, k_pool', v_pool')``."""
     attn = lambda u: L.decode_multihead_attention(
         u, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"],
-        k_cache, v_cache, pos, write_idx,
+        k_pool, v_pool, pos, rows, write_rows,
         n_heads_global=cfg.num_heads, ring=ring)
     ln1 = lambda u: L.layer_norm(u, p["ln1_s"], p["ln1_b"], cfg.ln_eps)
     ln2 = lambda u: L.layer_norm(u, p["ln2_s"], p["ln2_b"], cfg.ln_eps)
@@ -354,30 +334,53 @@ def block_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos,
     return x, kc, vc
 
 
-def stack_prefill(x, stacked_params, cfg: TransformerConfig,
-                  attn_mask=None, cache_dtype=None):
-    """Full-prompt forward over the stacked [L, ...] layers that also
-    stacks every layer's K/V — ``(x, k [L, B, T, n, d], v)``.  No remat:
-    there is no backward to replay for."""
-    def body(carry, lp):
-        x, k, v = block_prefill(carry, lp, cfg, attn_mask)
-        if cache_dtype is not None:
-            k, v = k.astype(cache_dtype), v.astype(cache_dtype)
-        return x, (k, v)
-
-    x, (ks, vs) = jax.lax.scan(body, x, stacked_params)
-    return x, ks, vs
+def block_extend(x, p, cfg: TransformerConfig, k_pool, v_pool, rows,
+                 start, n_new):
+    """One dense block on a BLOCK of new tokens x [B, E, h] against this
+    layer's KV page pool — the prefill / tail-prefill / verify body
+    (layers.extend_multihead_attention)."""
+    attn = lambda u: L.extend_multihead_attention(
+        u, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"],
+        k_pool, v_pool, rows, start, n_new,
+        n_heads_global=cfg.num_heads)
+    ln1 = lambda u: L.layer_norm(u, p["ln1_s"], p["ln1_b"], cfg.ln_eps)
+    ln2 = lambda u: L.layer_norm(u, p["ln2_s"], p["ln2_b"], cfg.ln_eps)
+    if cfg.pre_ln:
+        a, kc, vc = attn(ln1(x))
+        x = x + a
+        x = x + _mlp(ln2(x), p)
+    else:
+        a, kc, vc = attn(x)
+        x = ln1(x + a)
+        x = ln2(x + _mlp(x, p))
+    return x, kc, vc
 
 
 def stack_decode(x, stacked_params, cfg: TransformerConfig, k, v, pos,
-                 write_idx, ring: bool = False):
+                 rows, write_rows, ring: bool = False):
     """One decode step over the stacked layers: the scan consumes each
-    layer's cache slice and stacks the updated slices back — the caller
-    donates the cache buffers so XLA updates them in place."""
+    layer's pool slice and stacks the updated slices back — the caller
+    donates the pool buffers so XLA updates them in place."""
     def body(carry, xs):
         lp, kc, vc = xs
-        x, kc, vc = block_decode(carry, lp, cfg, kc, vc, pos, write_idx,
-                                 ring=ring)
+        x, kc, vc = block_decode(carry, lp, cfg, kc, vc, pos, rows,
+                                 write_rows, ring=ring)
+        return x, (kc, vc)
+
+    x, (k2, v2) = jax.lax.scan(body, x, (stacked_params, k, v))
+    return x, k2, v2
+
+
+def stack_extend(x, stacked_params, cfg: TransformerConfig, k, v, rows,
+                 start, n_new):
+    """A block of new tokens over the stacked layers (prefill / tail
+    prefill / speculative verify): each layer scatters its new K/V rows
+    into its pool slice and attends through the page-table view.  No
+    remat: there is no backward to replay for."""
+    def body(carry, xs):
+        lp, kc, vc = xs
+        x, kc, vc = block_extend(carry, lp, cfg, kc, vc, rows, start,
+                                 n_new)
         return x, (kc, vc)
 
     x, (k2, v2) = jax.lax.scan(body, x, (stacked_params, k, v))

@@ -19,10 +19,13 @@ Four event schemas share one stream (a rank-0 log interleaves them):
 * ``dstpu.telemetry.fleet``   — one line per cross-host aggregated window
   (v2, rank 0 only): per-host min/median/max timings, straggler index and
   flags, anomaly roll-up, counter sums, the full per-host report map.
-* ``dstpu.telemetry.serve``   — one line per serving window (v1, its own
+* ``dstpu.telemetry.serve``   — one line per serving window (own
   version track): continuous-batching decode iterations, tokens
   delivered, slot occupancy, and p50/p99 TTFT / inter-token latency
-  (deepspeed_tpu/inference/driver.py, docs/inference.md).
+  (deepspeed_tpu/inference/driver.py, docs/inference.md).  v1 (PR 10)
+  logs still validate; v2 adds the prefix-reuse and speculative-decoding
+  columns (``prefix_hits``, ``prefix_tokens_reused``, ``spec_proposed``,
+  ``spec_accepted``).
 
 Schema evolution contract: additive fields bump the version with
 validators accepting all :data:`ACCEPTED_VERSIONS` and unknown EXTRA
@@ -51,8 +54,9 @@ STARTUP_SCHEMA_ID = "dstpu.telemetry.startup"
 #: future additive field bumps SERVE_ACCEPTED_VERSIONS without touching
 #: the training schemas.
 SERVE_SCHEMA_ID = "dstpu.telemetry.serve"
-SERVE_SCHEMA_VERSION = 1
-SERVE_ACCEPTED_VERSIONS = (1,)
+SERVE_SCHEMA_VERSION = 2
+#: v1 = PR 10 logs (no prefix-reuse / speculative columns) — still valid
+SERVE_ACCEPTED_VERSIONS = (1, 2)
 
 _NUM = numbers.Real
 
@@ -165,6 +169,12 @@ SERVE_FIELDS = {
     "ttft_p99_ms": (_NUM, False),
     "itl_p50_ms": (_NUM, False),        # inter-token latency
     "itl_p99_ms": (_NUM, False),
+    # ---- v2 (prefix KV reuse + speculative decoding, PR 13) ----------
+    # cumulative over the scheduler's lifetime, like `evicted`
+    "prefix_hits": (int, True, 2),          # admissions served a prefix
+    "prefix_tokens_reused": (int, True, 2),  # prompt tokens not re-prefilled
+    "spec_proposed": (int, True, 2),        # draft tokens proposed
+    "spec_accepted": (int, True, 2),        # draft tokens accepted
     "counters": (dict, True),           # resilience/compile-cache roll-up
 }
 
@@ -346,6 +356,16 @@ def count_by_schema(path: str) -> dict:
     """``{schema_id_or_"invalid": count}`` over a JSONL file — the
     validator CLI's per-file summary."""
     out = {}
+    for (sid, _version), n in count_by_schema_version(path).items():
+        out[sid] = out.get(sid, 0) + n
+    return out
+
+
+def count_by_schema_version(path: str) -> dict:
+    """``{(schema_id_or_"invalid", version): count}`` over a JSONL file —
+    the version-aware validator summary (a mixed v1/v2 serve stream, e.g.
+    a replica upgraded mid-run, shows both tracks)."""
+    out = {}
     try:
         with open(path) as f:
             for line in f:
@@ -353,10 +373,13 @@ def count_by_schema(path: str) -> dict:
                 if not line:
                     continue
                 try:
-                    sid = json.loads(line).get("schema") or "invalid"
+                    ev = json.loads(line)
+                    sid = ev.get("schema") or "invalid"
+                    version = ev.get("version")
                 except ValueError:
-                    sid = "invalid"
-                out[sid] = out.get(sid, 0) + 1
+                    sid, version = "invalid", None
+                key = (sid, version)
+                out[key] = out.get(key, 0) + 1
     except OSError:
         pass
     return out

@@ -78,13 +78,36 @@ def serve(args):
           f"({engine.cache_spec.layout}), restore "
           f"{engine.restore_seconds:.2f}s")
 
-    reqs = synthetic_requests(
-        args.requests, vocab=VOCAB, seed=1, prompt_min=4,
-        prompt_max=min(16, engine.prefill_bucket),
-        new_min=4, new_max=args.max_new)
+    if args.prefix_trace:
+        # multi-tenant trace: every request shares a system prompt of
+        # two pages, so prefix reuse serves the shared pages and
+        # prefills only each tail (docs/inference.md "Prefix reuse")
+        from deepspeed_tpu.inference import Request
+        rng = np.random.default_rng(1)
+        sys_len = min(2 * engine.cache_spec.page_tokens,
+                      engine.prefill_bucket - 8)
+        sys_prompt = rng.integers(0, VOCAB, size=sys_len).astype(
+            int).tolist()
+        reqs = []
+        for i in range(args.requests):
+            tail = rng.integers(0, VOCAB, size=int(
+                rng.integers(2, 7))).astype(int).tolist()
+            reqs.append(Request(rid=i, prompt=sys_prompt + tail,
+                                max_new_tokens=int(
+                                    rng.integers(4, args.max_new + 1))))
+    else:
+        reqs = synthetic_requests(
+            args.requests, vocab=VOCAB, seed=1, prompt_min=4,
+            prompt_max=min(16, engine.prefill_bucket),
+            new_min=4, new_max=args.max_new)
     out = run_serve(engine, reqs, jsonl_path=args.jsonl,
                     window_iters=args.window)
 
+    if args.prefix_trace and engine.prefix_reuse \
+            and not out["summary"]["prefix_hit_rate"]:
+        print("ERROR: shared-prefix trace recorded no prefix hits",
+              file=_sys.stderr)
+        return 1
     empty = [r.rid for r in out["results"] if not r.tokens]
     for r in sorted(out["results"], key=lambda r: r.rid)[:4]:
         print(f"  req {r.rid}: prompt[{r.prompt_len}] -> "
@@ -105,6 +128,10 @@ def main():
                              "or any training run's save_dir)")
     parser.add_argument("--prepare", action="store_true",
                         help="train a tiny checkpoint instead of serving")
+    parser.add_argument("--prefix-trace", action="store_true",
+                        help="serve a multi-tenant trace sharing a "
+                             "system prompt (exercises prefix KV reuse; "
+                             "exits 1 if no hit was recorded)")
     parser.add_argument("--deepspeed_config",
                         default=_os.path.join(_os.path.dirname(__file__),
                                               "ds_config_serve.json"))

@@ -1,0 +1,616 @@
+"""Prefix KV reuse + speculative decoding (PR 13, docs/inference.md
+"Prefix reuse" / "Speculative decoding").
+
+The load-bearing pins:
+
+* **Page-table bookkeeping** — refcount on evict, published pages
+  surviving on the LRU, copy-on-write when a ring wrap would overwrite a
+  SHARED page, page-aligned prompts, sub-page prefixes (no reuse), and
+  capacity-exhausted admission refusal (queued, never half-allocated).
+* **Bitwise page identity** — a reused page is byte-identical to the
+  page a fresh prefill of the same prefix produces (same weights + same
+  tokens ⇒ same bytes), and the decode-exactness oracle stays pinned at
+  mp=1 AND mp=2 with prefix reuse ON.
+* **Greedy-output identity** — prefix reuse and speculative decoding are
+  FLOP optimizations, never generation changes: token streams equal the
+  no-reuse / target-only baselines, mixed hit/miss batches included.
+* **Exactly-N executables** — the new program set (tail bucket, draft
+  prefill, fused spec step) still matches the static prediction against
+  the runtime compile-cache and fence counters (the PR 11 contract).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import (ContinuousScheduler, InferenceEngine,
+                                     PagePool, Request, kvcache, run_serve)
+from deepspeed_tpu.models.gpt2 import GPT2
+
+TINY = dict(vocab_size=128, max_seq_len=64, num_layers=2, hidden_size=64,
+            num_heads=4)
+DRAFT = dict(vocab_size=128, max_seq_len=64, num_layers=1, hidden_size=32,
+             num_heads=2)
+
+
+def tiny_model():
+    return GPT2.from_size("tiny", **TINY)
+
+
+def serve_config(**inf):
+    base = {"max_slots": 3, "max_tokens": 32, "prefill_bucket": 24,
+            "page_tokens": 8, "dtype": "float32"}
+    base.update(inf)
+    return {"train_micro_batch_size_per_gpu": 1, "inference": base,
+            "graph_lint": "error",
+            "analysis": {"mode": "error", "profile": "v4-8"}}
+
+
+def spec_of(slots=3, capacity=32, pt=8, pool_pages=0, layout="paged"):
+    return kvcache.KVCacheSpec(layers=2, slots=slots, capacity=capacity,
+                               kv_heads_local=4, head_dim=16,
+                               dtype=np.float32, layout=layout,
+                               page_tokens=pt, pool_pages=pool_pages)
+
+
+# =====================================================================
+# PagePool bookkeeping (pure host, no programs)
+# =====================================================================
+
+def test_pool_refcount_on_evict():
+    pool = PagePool(spec_of())
+    prompt = list(range(17))                       # 2 full pages + tail
+    g0 = pool.admit(0, prompt, 4)
+    pool.publish(g0)
+    shared = pool.slot_pages(0)[:2]
+    g1 = pool.admit(1, prompt, 4)                  # hits both full pages
+    assert g1.reused_pages == 2 and g1.reused_tokens == 16
+    assert [pool.refcount(p) for p in shared] == [2, 2]
+    pool.release(0)                                # evict the publisher
+    assert [pool.refcount(p) for p in shared] == [1, 1]
+    pool.release(1)
+    # published pages at refcount 0 park on the LRU, still hittable
+    assert [pool.refcount(p) for p in shared] == [0, 0]
+    g2 = pool.admit(2, prompt, 4)
+    assert g2.reused_pages == 2                    # revived from the LRU
+    assert pool.slot_pages(2)[:2] == shared
+    assert [pool.refcount(p) for p in shared] == [1, 1]
+
+
+def test_pool_sub_page_prefix_never_hits():
+    pool = PagePool(spec_of())
+    g0 = pool.admit(0, list(range(17)), 4)
+    pool.publish(g0)
+    # same leading tokens, but shorter than one page — no reuse
+    g1 = pool.admit(1, list(range(7)), 4)
+    assert g1.reused_pages == 0 and g1.reused_tokens == 0
+    # exactly one page long: the last token must still be forwarded, so
+    # a single-page prompt cannot reuse its only page
+    pool.release(1)
+    g2 = pool.admit(1, list(range(8)), 4)
+    assert g2.reused_pages == 0
+
+
+def test_pool_page_aligned_prompt_reuses_all_but_last_page():
+    pool = PagePool(spec_of())
+    prompt = list(range(24))                       # exactly 3 pages
+    g0 = pool.admit(0, prompt, 4)
+    pool.publish(g0)                               # publishes all 3
+    g1 = pool.admit(1, prompt, 4)
+    # >= 1 token must be forwarded for the first generated token's
+    # logits, so the aligned prompt reuses pages 0..1, re-prefills page 2
+    assert g1.reused_pages == 2 and g1.reused_tokens == 16
+
+
+def test_pool_chained_hash_stops_at_first_divergence():
+    pool = PagePool(spec_of())
+    g0 = pool.admit(0, list(range(24)), 4)
+    pool.publish(g0)
+    diverged = list(range(8)) + [99] * 8 + list(range(16, 24))
+    g1 = pool.admit(1, diverged, 4)
+    assert g1.reused_pages == 1                    # page 0 only: the
+    # chain breaks at page 1 and page 2 CANNOT hit without it
+
+
+def test_pool_admission_refusal_and_lru_reclaim():
+    # pool of 6 pages, slots need ceil((prompt+budget)/8) pages each
+    pool = PagePool(spec_of(slots=3, pool_pages=6))
+    assert pool.admit(0, list(range(20)), 12) is not None   # 4 pages
+    g1 = pool.admit(1, list(range(30, 40)), 6)              # 2 pages
+    assert g1 is not None
+    assert pool.admit(2, list(range(50, 60)), 6) is None    # exhausted
+    assert pool.refusals == 1
+    assert pool.slot_pages(2) == []                # nothing half-allocated
+    pool.publish(g1)
+    pool.release(1)                                # 2 pages → LRU
+    # the allocator reclaims LRU pages (un-publishing them) when free
+    # pages run out
+    assert pool.admit(2, list(range(50, 60)), 6) is not None
+    assert pool.free_pages == 0
+
+
+def test_pool_pricing_is_pool_based():
+    spec = spec_of(slots=4, capacity=100, pt=64)   # rounds to 2 pages
+    assert spec.pages_per_slot == 2
+    assert spec.num_pages == 8
+    assert spec.pool_rows == 8 * 64
+    per_tok = 4 * 16 * 4                           # heads * dim * fp32
+    assert kvcache.cache_bytes(spec) == 2 * 2 * 8 * 64 * per_tok
+    # overcommitted pool prices FEWER bytes than slots × capacity
+    over = spec_of(slots=4, capacity=100, pt=64, pool_pages=5)
+    assert kvcache.cache_bytes(over) < kvcache.cache_bytes(spec)
+    with pytest.raises(ValueError, match="pool_pages"):
+        spec_of(slots=4, capacity=100, pt=64, pool_pages=1)
+
+
+# =====================================================================
+# engine: bitwise page identity + the oracle with reuse ON
+# =====================================================================
+
+def _pool_rows(eng, slot, n_rows):
+    """Host copy of the slot's first n_rows K rows: [L, n_rows, n, d]."""
+    rows = eng.pool.slot_rows(slot)[:n_rows]
+    k = np.asarray(eng._cache["k"])
+    return k[:, rows]
+
+
+def test_reused_pages_bitwise_equal_and_outputs_identical():
+    m = tiny_model()
+    eng = InferenceEngine(m, config=serve_config(), seed=0)
+    assert eng.prefix_reuse and eng.tail_bucket == 8
+    prefix = list(range(1, 17))                    # 2 full pages
+    sched = ContinuousScheduler(eng)
+    res = sched.run([Request(rid=i, prompt=prefix + [30 + i],
+                             max_new_tokens=4) for i in range(3)])
+    assert sched.prefix_hits == 2
+    assert sched.prefix_tokens_reused == 32
+    # a new admission's leading pages ARE the published ones (shared,
+    # not copied)
+    _, reused = eng.admit(0, prefix + [77], 2)
+    assert reused == 16 and eng.pool.shared_pages(0) == 2
+    shared_rows = _pool_rows(eng, 0, 16)
+
+    # a FRESH engine prefilling the same prefix produces byte-identical
+    # page content (same weights + same tokens ⇒ same bytes)
+    eng2 = InferenceEngine(m, config=serve_config(prefix_reuse=False),
+                           seed=0)
+    eng2.prefill(0, prefix + [77])
+    fresh_rows = _pool_rows(eng2, 0, 16)
+    np.testing.assert_array_equal(shared_rows, fresh_rows)
+
+    # and the token streams equal the no-reuse baseline exactly
+    base = ContinuousScheduler(eng2)
+    res2 = base.run([Request(rid=i, prompt=prefix + [30 + i],
+                             max_new_tokens=4) for i in range(3)])
+    assert ({r.rid: r.tokens for r in res}
+            == {r.rid: r.tokens for r in res2})
+
+
+@pytest.mark.parametrize("mp", [1, 2])
+def test_decode_oracle_with_prefix_reuse(mp):
+    """The decode-exactness oracle with reuse ON: a slot admitted over
+    SHARED prefix pages decodes argmax-identically to a full-context
+    re-forward, at mp=1 and mp=2."""
+    cfg = serve_config()
+    if mp > 1:
+        cfg["model_parallel_size"] = mp
+    eng = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    prefix = list(range(1, 17))
+    # slot 0 publishes the prefix; slot 1 is admitted over the shared
+    # pages (reuse ON) and then decodes incrementally
+    assert eng.admit(0, prefix + [50], 2) is not None
+    logits, reused = eng.admit(1, prefix + [60], 8)
+    assert reused == 16
+    seq = prefix + [60]
+    cur = int(np.argmax(logits))
+    for _ in range(4):
+        seq.append(cur)
+        ref = eng.prefill(2, seq)           # full re-forward, other slot
+        feed = np.zeros(eng.num_slots, np.int32)
+        feed[1] = cur
+        act = np.zeros(eng.num_slots, bool)
+        act[1] = True
+        dec = eng.decode(feed, act)[1]
+        assert int(np.argmax(dec)) == int(np.argmax(ref))
+        np.testing.assert_allclose(dec, ref, atol=1e-4)
+        cur = int(np.argmax(dec))
+
+
+def test_mixed_hit_miss_batching_invariance():
+    """Hitting and missing requests sharing decode iterations generate
+    exactly what they generate solo — reuse must stay invisible."""
+    eng = InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+    prefix = list(range(1, 17))
+    eng.prefill(0, prefix)                  # publish the prefix
+    eng.reset()                             # …but reset clears the index
+    prompts = [prefix + [40], [9, 8, 7], prefix + [41], [5, 5]]
+    eng.prefill(0, prefix + [99])           # re-publish on the live pool
+    eng.release(0)
+    together = eng.generate(prompts, max_new_tokens=5)
+    solo = []
+    for p in prompts:
+        eng.reset()
+        eng.prefill(0, prefix + [99])       # same index state per run
+        eng.release(0)
+        solo.append(eng.generate([p], max_new_tokens=5)[0])
+    assert together == solo
+
+
+def test_reset_clears_the_prefix_index():
+    eng = InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+    prefix = list(range(1, 17))
+    eng.prefill(0, prefix + [50])
+    eng.reset()
+    sched = ContinuousScheduler(eng)
+    sched.run([Request(rid=0, prompt=prefix + [51], max_new_tokens=2)])
+    assert sched.prefix_hits == 0           # nothing survives reset
+
+
+# =====================================================================
+# ring layout: copy-on-write on wrap of a shared page
+# =====================================================================
+
+def test_cow_on_ring_wrap_of_shared_page():
+    """Two CONCURRENT ring slots share a prefix page; one wraps past
+    capacity and would overwrite it — the engine copies the page out
+    first (refcount > 1 ⇒ COW) and the neighbour's stream is
+    untouched."""
+    cfg = serve_config(max_slots=2, max_tokens=16, prefill_bucket=16,
+                       kv_layout="ring")
+    m = tiny_model()
+    eng = InferenceEngine(m, config=cfg, seed=0)
+    assert eng._copy_page_fn is not None
+    prefix = list(range(1, 13))             # page 0 full, page 1 partial
+    wrapper = Request(rid=0, prompt=prefix + [50], max_new_tokens=10)
+    neighbour = Request(rid=1, prompt=prefix + [60], max_new_tokens=10)
+    sched = ContinuousScheduler(eng)
+    res = sched.run([wrapper, neighbour])
+    assert sched.prefix_hits == 1           # they shared page 0
+    assert eng.pool.cow_copies >= 1         # the wrap copied it out
+    # both streams equal their no-reuse solo runs
+    for req in (wrapper, neighbour):
+        solo = InferenceEngine(m, config=dict(
+            cfg, inference=dict(cfg["inference"], prefix_reuse=False)),
+            seed=0)
+        ref = ContinuousScheduler(solo).run(
+            [Request(rid=req.rid, prompt=list(req.prompt),
+                     max_new_tokens=req.max_new_tokens)])
+        got = next(r for r in res if r.rid == req.rid)
+        assert got.tokens == ref[0].tokens
+
+
+# =====================================================================
+# capacity-exhausted admission refusal (engine + scheduler)
+# =====================================================================
+
+def test_admission_refusal_queues_until_pages_free():
+    """An overcommitted pool refuses admissions instead of OOMing; the
+    refused request stays queued and completes once eviction releases
+    pages — with the same tokens as an uncontended run."""
+    m = tiny_model()
+    # 2 slots × 2 pages each need 4 pages at capacity 16/pt 8;
+    # pool_pages=3 cannot hold two full-budget requests at once
+    cfg = serve_config(max_slots=2, max_tokens=16, prefill_bucket=16,
+                       pool_pages=3, prefix_reuse=False)
+    eng = InferenceEngine(m, config=cfg, seed=0)
+    reqs = [Request(rid=i, prompt=[1 + i, 2 + i, 3 + i],
+                    max_new_tokens=10) for i in range(3)]
+    sched = ContinuousScheduler(eng)
+    res = sched.run([Request(rid=r.rid, prompt=list(r.prompt),
+                             max_new_tokens=r.max_new_tokens)
+                     for r in reqs])
+    assert sched.admission_refusals > 0
+    assert len(res) == 3
+    free = InferenceEngine(m, config=serve_config(
+        max_slots=2, max_tokens=16, prefill_bucket=16,
+        prefix_reuse=False), seed=0)
+    ref = ContinuousScheduler(free).run(reqs)
+    assert ({r.rid: r.tokens for r in res}
+            == {r.rid: r.tokens for r in ref})
+
+
+# =====================================================================
+# speculative decoding
+# =====================================================================
+
+def spec_config(j=3, **inf):
+    base = {"prefill_bucket": 16, "page_tokens": 16}
+    base.update(inf)
+    cfg = serve_config(**base)
+    cfg["inference"]["speculative"] = {"draft_tokens": j}
+    return cfg
+
+
+def test_spec_outputs_identical_to_target_only():
+    """The exactness-by-construction contract: with ANY draft — a
+    different (smaller) model or an identical twin — the emitted stream
+    equals target-only greedy decode, token for token."""
+    m = tiny_model()
+    reqs = lambda: [Request(rid=i, prompt=[1 + i, 2 + i, 3],
+                            max_new_tokens=7) for i in range(5)]
+    base = InferenceEngine(m, config=serve_config(
+        prefill_bucket=16, page_tokens=16), seed=0)
+    sb = ContinuousScheduler(base)
+    want = {r.rid: r.tokens for r in sb.run(reqs())}
+
+    small = InferenceEngine(m, config=spec_config(), seed=0,
+                            draft_model=GPT2.from_size("tiny", **DRAFT))
+    ss = ContinuousScheduler(small)
+    got = {r.rid: r.tokens for r in ss.run(reqs())}
+    assert got == want
+    assert ss.spec_proposed > 0
+    assert 0 <= ss.spec_accepted <= ss.spec_proposed
+    # one dispatch per up-to-(J+1) tokens: never more iterations than
+    # the per-token baseline
+    assert ss.decode_iters <= sb.decode_iters
+
+    twin = InferenceEngine(
+        m, config=spec_config(), seed=0,
+        draft_model=tiny_model(),
+        draft_params=tiny_model().init_params(jax.random.PRNGKey(0)))
+    st = ContinuousScheduler(twin)
+    assert {r.rid: r.tokens for r in st.run(reqs())} == want
+    # the identical twin agrees (near-)always → fewer target dispatches
+    assert st.decode_iters < sb.decode_iters
+    assert st.spec_accepted >= ss.spec_accepted
+
+
+def test_spec_eos_mid_block_and_budget():
+    """EOS landing inside a speculative block stops the slot exactly
+    like target-only decode (finish reason, token list, budgets)."""
+    m = tiny_model()
+    base = InferenceEngine(m, config=serve_config(
+        prefill_bucket=16, page_tokens=16), seed=0)
+    ref = ContinuousScheduler(base).run(
+        [Request(rid=0, prompt=[3, 1], max_new_tokens=9, eos_id=None)])
+    eos = ref[0].tokens[2]                  # force an eos mid-stream
+    r_ref = ContinuousScheduler(base).run(
+        [Request(rid=0, prompt=[3, 1], max_new_tokens=9, eos_id=eos)])
+    spec = InferenceEngine(m, config=spec_config(), seed=0,
+                           draft_model=GPT2.from_size("tiny", **DRAFT))
+    r_spec = ContinuousScheduler(spec).run(
+        [Request(rid=0, prompt=[3, 1], max_new_tokens=9, eos_id=eos)])
+    assert r_spec[0].tokens == r_ref[0].tokens
+    assert r_spec[0].finish_reason == r_ref[0].finish_reason == "eos"
+
+
+def test_spec_draft_cache_has_no_holes_after_full_acceptance():
+    """A fully-accepted block advances pos by J+1, so draft row pos+J
+    becomes draft HISTORY — the chain runs J+1 draft steps precisely so
+    that row is written (review regression: it stayed zero forever,
+    silently decaying the accept rate of every later block)."""
+    m = tiny_model()
+    twin = InferenceEngine(
+        m, config=spec_config(j=3), seed=0,
+        draft_model=tiny_model(),
+        draft_params=tiny_model().init_params(jax.random.PRNGKey(0)))
+    sched = ContinuousScheduler(twin)
+    res = sched.run([Request(rid=0, prompt=[1, 2, 3],
+                             max_new_tokens=12)])
+    assert len(res[0].tokens) == 12
+    # the twin accepts (nearly) everything, so blocks advance J+1 —
+    # every draft-history row up to the last written position must be
+    # populated (norm > 0; a zero row is the hole)
+    written = 3 + 12 - 1                  # prompt + generated - feed
+    kd = np.asarray(twin._draft_cache["k"])      # [L, R, n, d]
+    rows = twin._draft_rows[0][:written]
+    norms = np.abs(kd[:, rows]).sum(axis=(0, 2, 3))
+    assert np.all(norms > 0), f"zero draft rows at {np.where(norms == 0)}"
+
+
+def test_spec_custom_sampler_falls_back_loudly():
+    eng = InferenceEngine(tiny_model(), config=spec_config(), seed=0,
+                          draft_model=GPT2.from_size("tiny", **DRAFT))
+    sched = ContinuousScheduler(eng, sampler=lambda row: 7)
+    sched.run([Request(rid=0, prompt=[1, 2], max_new_tokens=3)])
+    assert eng._warned_fused_fallback
+    assert sched.spec_proposed == 0         # the fused path never ran
+
+
+def test_spec_config_guards():
+    with pytest.raises(DeepSpeedConfigError, match="speculative"):
+        InferenceEngine(tiny_model(), config=spec_config(
+            kv_layout="ring"))
+    with pytest.raises(DeepSpeedConfigError, match="speculative"):
+        InferenceEngine(tiny_model(), config=spec_config(
+            decode_iters_per_dispatch=4))
+    bad = spec_config()
+    bad["inference"]["speculative"]["drafty"] = 1
+    with pytest.raises(DeepSpeedConfigError, match="drafty"):
+        InferenceEngine(tiny_model(), config=bad)
+    # draft_tokens > 0 with neither draft_model nor draft_size is loud
+    with pytest.raises(DeepSpeedConfigError, match="draft"):
+        InferenceEngine(tiny_model(), config=spec_config())
+    # vocab mismatch is loud (acceptance compares token ids)
+    with pytest.raises(DeepSpeedConfigError, match="vocab"):
+        InferenceEngine(tiny_model(), config=spec_config(), seed=0,
+                        draft_model=GPT2.from_size(
+                            "tiny", **dict(DRAFT, vocab_size=64)))
+
+
+def test_spec_verify_never_writes_past_allocation():
+    """A speculative verify block WIDER than the slot's remaining
+    budget aims writes past the slot's allocated pages — those must be
+    DROPPED, never land in pages the slot does not own.  (Review
+    regression: unallocated page-table entries used to resolve to
+    page 0, silently corrupting whichever request — or published shared
+    prefix — held it.)"""
+    m = tiny_model()
+    # capacity 32 = 4 pages/slot, but the request allocates only 2
+    # (prompt 7 + budget 9 = 16 rows); its final spec block (pos 14,
+    # remaining 1) writes verify rows 14..20 — rows 16..20 aim at the
+    # 3rd, UNALLOCATED table entry
+    cfg = spec_config(j=6, max_slots=1, max_tokens=32, prefill_bucket=16,
+                      page_tokens=8, pool_pages=4)
+    eng = InferenceEngine(m, config=cfg, seed=0,
+                          draft_model=GPT2.from_size("tiny", **DRAFT))
+    # the drop-row convention, checked at the map level
+    rows = eng.pool.rows()
+    assert rows.shape == (1, 32)
+    sched = ContinuousScheduler(eng)
+    owned = set()
+    sched.submit(Request(rid=0, prompt=list(range(1, 8)),
+                         max_new_tokens=9))
+    while sched.queue or sched.active:
+        sched.step()
+        for page in eng.pool.slot_pages(0):       # before eviction
+            owned.update(range(page * 8, page * 8 + 8))
+    assert len(sched.results[0].tokens) == 9
+    unowned = sorted(set(range(eng.cache_spec.pool_rows)) - owned)
+    assert len(unowned) == 16                     # 2 pages never owned
+    k = np.asarray(eng._cache["k"])
+    v = np.asarray(eng._cache["v"])
+    # never-allocated pool pages are bitwise untouched (still zeros)
+    assert not np.any(k[:, unowned])
+    assert not np.any(v[:, unowned])
+    # and unallocated table entries resolve to the drop row
+    assert np.all(eng.pool.rows()[0, 16:] == eng.cache_spec.pool_rows)
+
+
+def test_pool_refusal_counts_revived_lru_hits():
+    """The refusal check must not count LRU pages the admission itself
+    is about to revive as hits — that passed the check and then ran the
+    allocator dry mid-admission (review regression: refcounts were
+    corrupted and the table write crashed instead of refusing)."""
+    pool = PagePool(spec_of(slots=3, capacity=24, pool_pages=4))
+    a = pool.admit(0, list(range(16)), 0)          # 2 pages
+    pool.publish(a)                                # both pages indexed
+    pool.release(0)                                # -> LRU (published)
+    assert pool.admit(1, list(range(30, 46)), 0) is not None  # drains free
+    # hits BOTH LRU pages and needs 1 fresh page — nothing allocatable
+    refused = pool.admit(2, list(range(16)) + [99], 7)
+    assert refused is None and pool.refusals == 1
+    assert pool.slot_pages(2) == []                # nothing half-applied
+    assert int(pool._ref.max()) <= 1               # refcounts untouched
+    # once the neighbour releases, the same admission succeeds
+    pool.release(1)
+    g = pool.admit(2, list(range(16)) + [99], 7)
+    assert g is not None and g.reused_pages == 2
+
+
+def test_prefill_raises_loudly_on_exhausted_overcommitted_pool():
+    """engine.prefill (the no-reuse oracle/baseline path) allocates the
+    full slot range and has no queue to fall back to — on an
+    overcommitted pool it must raise an actionable error, not corrupt
+    state."""
+    cfg = serve_config(max_slots=2, max_tokens=16, prefill_bucket=16,
+                       pool_pages=3, prefix_reuse=False)
+    eng = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    eng.prefill(0, [1, 2, 3])                      # holds 2 of 3 pages
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        eng.prefill(1, [4, 5, 6])
+    eng.release(0)
+    assert eng.prefill(1, [4, 5, 6]) is not None   # recovers cleanly
+
+
+# =====================================================================
+# telemetry: serve schema v2 + summary columns
+# =====================================================================
+
+def test_serve_summary_and_v2_events(tmp_path):
+    from deepspeed_tpu.observability import schema
+    m = tiny_model()
+    eng = InferenceEngine(m, config=spec_config(prefill_bucket=24,
+                                                page_tokens=8), seed=0,
+                          draft_model=GPT2.from_size("tiny", **DRAFT))
+    prefix = list(range(1, 17))
+    path = str(tmp_path / "serve.jsonl")
+    out = run_serve(eng, [Request(rid=i, prompt=prefix + [40 + i],
+                                  max_new_tokens=5) for i in range(4)],
+                    jsonl_path=path, window_iters=2)
+    s = out["summary"]
+    assert s["prefix_hit_rate"] == 0.75             # 3 of 4 admissions
+    assert s["prefill_tokens_saved"] == 48
+    assert s["spec_accept_rate"] is not None
+    assert s["draft_params"] and s["draft_params"] > 0
+    assert schema.validate_jsonl(path) == []
+    import json
+    serve = [json.loads(l) for l in open(path)
+             if json.loads(l).get("schema") == schema.SERVE_SCHEMA_ID]
+    assert serve and serve[-1]["version"] == 2
+    assert serve[-1]["prefix_hits"] == 3
+    assert serve[-1]["prefix_tokens_reused"] == 48
+    assert serve[-1]["spec_proposed"] > 0
+
+
+def test_serve_schema_version_awareness():
+    """v1 logs (PR 10, no reuse/spec columns) still validate; a v2
+    event missing them does not."""
+    from deepspeed_tpu.observability import schema
+    v1 = {"schema": schema.SERVE_SCHEMA_ID, "version": 1, "ts": 1.0,
+          "window": 1, "decode_iters": 4, "tokens_out": 9,
+          "admitted": 2, "evicted": 1, "active_slots_mean": 1.5,
+          "queue_depth": 0, "slots": 4, "kv_cache_gb": 0.1,
+          "tokens_per_sec": 10.0, "ttft_p50_ms": 1.0,
+          "ttft_p99_ms": 2.0, "itl_p50_ms": 0.5, "itl_p99_ms": 0.9,
+          "counters": {}}
+    assert schema.validate_any(v1) is None
+    v2 = dict(v1, version=2)
+    msg = schema.validate_any(v2)
+    assert msg is not None and "prefix_hits" in msg
+    v2.update({"prefix_hits": 0, "prefix_tokens_reused": 0,
+               "spec_proposed": 0, "spec_accepted": 0})
+    assert schema.validate_any(v2) is None
+
+
+# =====================================================================
+# exactly-N executables + counted fences (the PR 11 contract, new N)
+# =====================================================================
+
+def test_contract_executables_with_tail_and_spec(tmp_path):
+    from deepspeed_tpu.observability import fences as obs_fences
+    from deepspeed_tpu.resilience import COUNTERS
+    from deepspeed_tpu.utils import compile_cache
+
+    d = str(tmp_path / "cc")
+    compile_cache.enable(d)
+    jax.clear_caches()
+    try:
+        m = tiny_model()
+        # ---- reuse engine: prefill + prefill_tail + decode = 3
+        eng = InferenceEngine(m, config=serve_config(), seed=0)
+        assert eng.tail_bucket == 8
+        m0, f0 = COUNTERS.compile_cache_misses, obs_fences.FENCE_COUNT
+        prefix = list(range(1, 17))
+        eng.admit(0, prefix + [50], 2)          # miss → full bucket
+        eng.admit(1, prefix + [60], 2)          # hit, tail 1 → tail bucket
+        eng.admit(2, prefix + [61], 2)          # hit again (cached prog)
+        toks = np.zeros((eng.num_slots,), np.int32)
+        act = np.ones((eng.num_slots,), bool)
+        for _ in range(3):
+            eng.decode(toks, act)
+        pred = eng.predict_executables()
+        assert pred.total == 3
+        assert COUNTERS.compile_cache_misses - m0 == 3
+        from deepspeed_tpu.analysis import dispatchplan
+        plans = eng.plan_dispatch()
+        predicted = dispatchplan.serve_predict_fences(plans, prefills=3,
+                                                      decode_iters=3)
+        assert obs_fences.FENCE_COUNT - f0 == predicted == 6
+        assert not eng.run_stability().errors
+
+        # ---- spec engine: prefill + draft_prefill + spec_step = 3
+        # (tail bucket off: page_tokens == bucket)
+        jax.clear_caches()
+        eng2 = InferenceEngine(m, config=spec_config(), seed=0,
+                               draft_model=GPT2.from_size("tiny", **DRAFT))
+        assert eng2.tail_bucket == 0
+        m1, f1 = COUNTERS.compile_cache_misses, obs_fences.FENCE_COUNT
+        sched = ContinuousScheduler(eng2)
+        sched.run([Request(rid=i, prompt=[1 + i, 2], max_new_tokens=6)
+                   for i in range(3)])
+        pred2 = eng2.predict_executables()
+        assert pred2.total == 3
+        assert sorted(p[0] for p in pred2.programs) == [
+            "draft_prefill", "prefill", "spec_step"]
+        assert COUNTERS.compile_cache_misses - m1 == 3
+        plans2 = eng2.plan_dispatch()
+        predicted2 = dispatchplan.serve_predict_fences(
+            plans2, prefills=sched.admitted,
+            decode_iters=sched.decode_iters)
+        assert obs_fences.FENCE_COUNT - f1 == predicted2
+        assert not eng2.run_stability().errors
+    finally:
+        compile_cache.disable()
