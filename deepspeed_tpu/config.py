@@ -684,7 +684,8 @@ class DeepSpeedConfig:
                      C.INFERENCE_QUANTIZE,
                      C.INFERENCE_DECODE_ITERS_PER_DISPATCH,
                      C.INFERENCE_PREFIX_REUSE, C.INFERENCE_POOL_PAGES,
-                     C.INFERENCE_TAIL_BUCKET, C.INFERENCE_SPECULATIVE}
+                     C.INFERENCE_TAIL_BUCKET, C.INFERENCE_SPECULATIVE,
+                     C.INFERENCE_OBSERVABILITY}
         if inf is not None and set(inf) - inf_known:
             # a typo'd serving knob would silently serve with defaults —
             # loud, like the resilience section
@@ -821,6 +822,112 @@ class DeepSpeedConfig:
                     f"the paged kv_layout: the multi-position verify "
                     f"step cannot wrap a ring window mid-block "
                     f"(docs/inference.md)")
+
+        # replica observability: request events, live endpoints, the
+        # serve watchdog and anomaly detectors (docs/observability.md
+        # "Serving view") — all host-side, trajectory-neutral
+        obs = get_scalar_param(inf, C.INFERENCE_OBSERVABILITY, None)
+        if obs is not None and not isinstance(obs, Mapping):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY} must be a "
+                f"JSON object, got {obs!r}")
+        obs_known = {C.INFERENCE_OBS_WINDOW_ITERS,
+                     C.INFERENCE_OBS_JSONL_PATH,
+                     C.INFERENCE_OBS_REQUEST_EVENTS,
+                     C.INFERENCE_OBS_HEALTH_PORT,
+                     C.INFERENCE_OBS_WATCHDOG_TIMEOUT_S,
+                     C.INFERENCE_OBS_WATCHDOG_ABORT,
+                     C.INFERENCE_OBS_FLIGHT_RECORDER_DIR,
+                     C.INFERENCE_OBS_STARVATION_WINDOWS,
+                     C.INFERENCE_OBS_ACCEPT_FLOOR,
+                     C.INFERENCE_OBS_THRASH_RECLAIMS}
+        if obs is not None and set(obs) - obs_known:
+            raise DeepSpeedConfigError(
+                f"unknown {C.INFERENCE}.{C.INFERENCE_OBSERVABILITY} "
+                f"key(s) {sorted(set(obs) - obs_known)}; supported: "
+                f"{sorted(obs_known)}")
+        obs = obs or {}
+
+        def _obs_inf_num(key, default, cast):
+            val = obs.get(key, default)
+            try:
+                return cast(val)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}.{key} "
+                    f"must be a number, got {val!r}")
+
+        self.inference_obs_window_iters = _obs_inf_num(
+            C.INFERENCE_OBS_WINDOW_ITERS,
+            C.INFERENCE_OBS_WINDOW_ITERS_DEFAULT, int)
+        if self.inference_obs_window_iters < 1:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_WINDOW_ITERS} must be >= 1")
+        self.inference_obs_jsonl_path = obs.get(
+            C.INFERENCE_OBS_JSONL_PATH, C.INFERENCE_OBS_JSONL_PATH_DEFAULT)
+        if self.inference_obs_jsonl_path is not None \
+                and not isinstance(self.inference_obs_jsonl_path, str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_JSONL_PATH} must be a path string, "
+                f"got {self.inference_obs_jsonl_path!r}")
+        self.inference_obs_request_events = bool(obs.get(
+            C.INFERENCE_OBS_REQUEST_EVENTS,
+            C.INFERENCE_OBS_REQUEST_EVENTS_DEFAULT))
+        self.inference_obs_health_port = _obs_inf_num(
+            C.INFERENCE_OBS_HEALTH_PORT,
+            C.INFERENCE_OBS_HEALTH_PORT_DEFAULT, int)
+        if not (0 <= self.inference_obs_health_port <= 65535):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_HEALTH_PORT} must be in [0, 65535]")
+        self.inference_obs_watchdog_timeout_s = _obs_inf_num(
+            C.INFERENCE_OBS_WATCHDOG_TIMEOUT_S,
+            C.INFERENCE_OBS_WATCHDOG_TIMEOUT_S_DEFAULT, float)
+        if self.inference_obs_watchdog_timeout_s < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_WATCHDOG_TIMEOUT_S} must be >= 0 "
+                f"(0 = off)")
+        self.inference_obs_watchdog_abort = bool(obs.get(
+            C.INFERENCE_OBS_WATCHDOG_ABORT,
+            C.INFERENCE_OBS_WATCHDOG_ABORT_DEFAULT))
+        self.inference_obs_flight_recorder_dir = obs.get(
+            C.INFERENCE_OBS_FLIGHT_RECORDER_DIR,
+            C.INFERENCE_OBS_FLIGHT_RECORDER_DIR_DEFAULT)
+        if self.inference_obs_flight_recorder_dir is not None \
+                and not isinstance(self.inference_obs_flight_recorder_dir,
+                                   str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_FLIGHT_RECORDER_DIR} must be a "
+                f"directory string, got "
+                f"{self.inference_obs_flight_recorder_dir!r}")
+        self.inference_obs_starvation_windows = _obs_inf_num(
+            C.INFERENCE_OBS_STARVATION_WINDOWS,
+            C.INFERENCE_OBS_STARVATION_WINDOWS_DEFAULT, int)
+        if self.inference_obs_starvation_windows < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_STARVATION_WINDOWS} must be >= 0 "
+                f"(0 = off)")
+        self.inference_obs_accept_floor = _obs_inf_num(
+            C.INFERENCE_OBS_ACCEPT_FLOOR,
+            C.INFERENCE_OBS_ACCEPT_FLOOR_DEFAULT, float)
+        if not (0.0 <= self.inference_obs_accept_floor < 1.0):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_ACCEPT_FLOOR} must be in [0, 1) "
+                f"(0 = off)")
+        self.inference_obs_thrash_reclaims = _obs_inf_num(
+            C.INFERENCE_OBS_THRASH_RECLAIMS,
+            C.INFERENCE_OBS_THRASH_RECLAIMS_DEFAULT, int)
+        if self.inference_obs_thrash_reclaims < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_OBSERVABILITY}."
+                f"{C.INFERENCE_OBS_THRASH_RECLAIMS} must be >= 0 "
+                f"(0 = off)")
 
         # jax.profiler trace window (TPU tracing analog of
         # wall_clock_breakdown; trace viewable in TensorBoard/Perfetto)

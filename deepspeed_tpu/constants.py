@@ -352,6 +352,55 @@ INFERENCE_SPEC_DRAFT_CHECKPOINT = "draft_checkpoint"
 INFERENCE_SPEC_DRAFT_CHECKPOINT_DEFAULT = None
 INFERENCE_SPEC_DRAFT_TAG = "draft_tag"
 INFERENCE_SPEC_DRAFT_TAG_DEFAULT = None
+# replica observability (docs/observability.md "Serving view"): the
+# serving analog of the top-level "observability" section — per-request
+# lifecycle events, live /healthz /status /metrics endpoints, a hang
+# watchdog armed around every prefill/decode dispatch, and the serve
+# anomaly detectors.  All host-side: zero effect on the compiled
+# programs, the greedy-output contract, or the fence counter.
+INFERENCE_OBSERVABILITY = "observability"
+# decode iterations folded into one dstpu.telemetry.serve window event
+INFERENCE_OBS_WINDOW_ITERS = "window_iters"
+INFERENCE_OBS_WINDOW_ITERS_DEFAULT = 8
+# serve telemetry JSONL path (window + startup + request events share
+# the stream; the run_serve jsonl_path argument beats it)
+INFERENCE_OBS_JSONL_PATH = "jsonl_path"
+INFERENCE_OBS_JSONL_PATH_DEFAULT = None
+# emit one dstpu.telemetry.request line per completed request
+INFERENCE_OBS_REQUEST_EVENTS = "request_events"
+INFERENCE_OBS_REQUEST_EVENTS_DEFAULT = True
+# > 0 serves /healthz /status /metrics on port + process_index (env
+# fallback DSTPU_HEALTH_PORT via dst --health_port / serve_gpt2.py
+# --health_port, same resolution as observability.health_port)
+INFERENCE_OBS_HEALTH_PORT = "health_port"
+INFERENCE_OBS_HEALTH_PORT_DEFAULT = 0
+# > 0 arms a hang watchdog around every prefill/decode dispatch (the
+# deadline scales by decode_iters_per_dispatch / draft_tokens+1 for the
+# fused programs); a fire marks the replica unhealthy (/healthz 503)
+# and dumps stacks + the flight-recorder ring
+INFERENCE_OBS_WATCHDOG_TIMEOUT_S = "watchdog_timeout_s"
+INFERENCE_OBS_WATCHDOG_TIMEOUT_S_DEFAULT = 0.0
+# abort the process (exit 44) after a watchdog fire, like
+# resilience.watchdog_abort
+INFERENCE_OBS_WATCHDOG_ABORT = "watchdog_abort"
+INFERENCE_OBS_WATCHDOG_ABORT_DEFAULT = False
+# flight-recorder dump destination (default: the JSONL log's directory,
+# else cwd; env fallback DSTPU_FLIGHTREC_DIR)
+INFERENCE_OBS_FLIGHT_RECORDER_DIR = "flight_recorder_dir"
+INFERENCE_OBS_FLIGHT_RECORDER_DIR_DEFAULT = None
+# admission-starvation detector: flag a window where requests waited
+# the whole window (queue non-empty, zero admissions, refusals grew)
+INFERENCE_OBS_STARVATION_WINDOWS = "starvation_windows"
+INFERENCE_OBS_STARVATION_WINDOWS_DEFAULT = 1
+# speculative accept-rate collapse floor (windows with enough proposals
+# whose accept rate falls below it are flagged); 0 disables
+INFERENCE_OBS_ACCEPT_FLOOR = "accept_floor"
+INFERENCE_OBS_ACCEPT_FLOOR_DEFAULT = 0.25
+# page-pool thrash detector: flag a window reclaiming at least this
+# many published LRU pages AND more than it served prefix hits
+# (the prefix cache churning faster than it helps); 0 disables
+INFERENCE_OBS_THRASH_RECLAIMS = "thrash_reclaims"
+INFERENCE_OBS_THRASH_RECLAIMS_DEFAULT = 8
 
 #############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import logging
 import time
+from contextlib import nullcontext
 from typing import Optional
 
 import jax
@@ -60,7 +61,10 @@ from deepspeed_tpu import checkpoint
 from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.inference import kvcache, quant
 from deepspeed_tpu.observability import fences as obs_fences
+from deepspeed_tpu.observability.flightrec import RECORDER as _RECORDER
+from deepspeed_tpu.observability.tracing import annotate
 from deepspeed_tpu.parallel.topology import MODEL_AXIS, make_mesh
+from deepspeed_tpu.resilience import chaos as _chaos
 
 logger = logging.getLogger(__name__)
 
@@ -277,6 +281,11 @@ class InferenceEngine:
                               if self.cache_spec.ring and self.prefix_reuse
                               else None)
         self._warned_fused_fallback = False
+        # replica observability hooks (inference/observability.py): a
+        # watchdog attached here arms around every dispatch; the decode
+        # dispatch counter feeds breadcrumbs + the chaos stall point
+        self.watchdog = None
+        self.decode_dispatches = 0
         self._gate_programs()
 
     # ------------------------------------------------------------ helpers
@@ -869,6 +878,23 @@ class InferenceEngine:
         return min(vals) if vals else None
 
     # ------------------------------------------------------------- serving
+    def attach_watchdog(self, watchdog) -> None:
+        """Arm ``watchdog`` around every subsequent prefill / decode /
+        copy-on-write dispatch (the blocking host regions: dispatch +
+        the sampler's fence).  Built from
+        ``inference.observability.watchdog_timeout_s`` by
+        :class:`~deepspeed_tpu.inference.observability.ServeObservability`;
+        the fused programs scale their region's deadline by their width
+        (``decode_iters_per_dispatch`` / ``draft_tokens + 1``) exactly
+        like the multi-step driver's ``deadline_scale``
+        (docs/resilience.md "Watchdog tuning")."""
+        self.watchdog = watchdog
+
+    def _armed(self, label: str, scale: float = 1.0):
+        wd = self.watchdog
+        return (wd.armed(label, deadline_scale=scale)
+                if wd is not None else nullcontext())
+
     def reset(self):
         """Clear every slot and the whole prefix index.  The old cache
         buffers are released BEFORE the fresh zeroed pool is placed — a
@@ -925,6 +951,11 @@ class InferenceEngine:
         grant = self.pool.admit(slot, toks.tolist(), int(max_new_tokens),
                                 reuse=reuse)
         if grant is None:
+            # breadcrumb: refusals are the admission-starvation signal
+            # a post-mortem must see in the ring
+            _RECORDER.record("serve_refusal", slot=int(slot),
+                             prompt_tokens=int(toks.size),
+                             free_pages=self.pool.free_pages)
             return None
         start = grant.reused_tokens
         tail = toks[start:]
@@ -934,27 +965,37 @@ class InferenceEngine:
             fn, bucket = self._prefill_tail_fn, self.tail_bucket
         padded, n_new = self._pad_prompt(tail, bucket)
         rows = self.pool.slot_rows(slot)[None]
+        _RECORDER.record("serve_admit", slot=int(slot),
+                         prompt_tokens=int(toks.size),
+                         reused_tokens=int(start),
+                         pages=len(self.pool.slot_pages(slot)))
         t0 = time.perf_counter()
-        logits, k, v, pos = fn(
-            self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], padded, rows, np.int32(slot),
-            np.int32(start), n_new)
-        self._cache = {"k": k, "v": v, "pos": pos}
-        if self._draft_prefill_fn is not None:
-            # the draft has no prefix index: its cache prefills the FULL
-            # prompt (cheap by construction — that is what a draft is)
-            dpad, dn = self._pad_prompt(toks, self.prefill_bucket)
-            _, kd, vd, posd = self._draft_prefill_fn(
-                self.draft_params, self._draft_cache["k"],
-                self._draft_cache["v"], self._draft_cache["pos"], dpad,
-                self._draft_rows[slot][None], np.int32(slot),
-                np.int32(0), dn)
-            self._draft_cache = {"k": kd, "v": vd, "pos": posd}
-        # the sampler's data dependency: ONE counted fence per admission
-        # (observability/fences.py — the dispatch plan predicts exactly
-        # this counter, tests/test_dispatch_stability.py)
-        out = np.asarray(obs_fences.read_arrays(logits)[0],
-                         np.float32)[0]
+        # watchdog-armed + dstpu/serve_prefill-annotated: the blocking
+        # host region is the dispatch plus the sampler's fence below
+        with self._armed("serve_prefill"), annotate("serve_prefill"):
+            logits, k, v, pos = fn(
+                self.params, self._cache["k"], self._cache["v"],
+                self._cache["pos"], padded, rows, np.int32(slot),
+                np.int32(start), n_new)
+            self._cache = {"k": k, "v": v, "pos": pos}
+            if self._draft_prefill_fn is not None:
+                # the draft has no prefix index: its cache prefills the
+                # FULL prompt (cheap by construction — that is what a
+                # draft is)
+                dpad, dn = self._pad_prompt(toks, self.prefill_bucket)
+                with annotate("serve_draft_prefill"):
+                    _, kd, vd, posd = self._draft_prefill_fn(
+                        self.draft_params, self._draft_cache["k"],
+                        self._draft_cache["v"], self._draft_cache["pos"],
+                        dpad, self._draft_rows[slot][None], np.int32(slot),
+                        np.int32(0), dn)
+                self._draft_cache = {"k": kd, "v": vd, "pos": posd}
+            # the sampler's data dependency: ONE counted fence per
+            # admission (observability/fences.py — the dispatch plan
+            # predicts exactly this counter,
+            # tests/test_dispatch_stability.py)
+            out = np.asarray(obs_fences.read_arrays(logits)[0],
+                             np.float32)[0]
         if self.prefix_reuse:
             self.pool.publish(grant)
         self._host_pos[slot] = toks.size
@@ -966,6 +1007,11 @@ class InferenceEngine:
     def release(self, slot: int) -> None:
         """Evict ``slot``: decrement every page refcount (shared pages
         survive for other slots / the LRU prefix cache)."""
+        if self.pool.slot_pages(int(slot)):
+            # breadcrumb only when pages were actually held (admit()
+            # calls release() defensively on empty slots)
+            _RECORDER.record("serve_evict", slot=int(slot),
+                             pages=len(self.pool.slot_pages(int(slot))))
         self.pool.release(int(slot))
         self._host_pos[slot] = 0
 
@@ -1005,10 +1051,15 @@ class InferenceEngine:
             pos = int(self._host_pos[slot])
             copies = self.pool.prepare_write(
                 int(slot), range(pos, pos + width))
+            if copies:
+                _RECORDER.record("serve_cow", slot=int(slot),
+                                 copies=len(copies))
             for src, dst in copies:
-                k, v = self._copy_page_fn(
-                    self._cache["k"], self._cache["v"],
-                    np.int32(src), np.int32(dst))
+                with self._armed("serve_copy_page"), \
+                        annotate("serve_copy_page"):
+                    k, v = self._copy_page_fn(
+                        self._cache["k"], self._cache["v"],
+                        np.int32(src), np.int32(dst))
                 self._cache["k"], self._cache["v"] = k, v
 
     def decode(self, tokens, active) -> np.ndarray:
@@ -1018,15 +1069,24 @@ class InferenceEngine:
         rows are meaningless); per-slot positions advance by ``active``."""
         active = np.asarray(active, bool)
         self._ring_write_barrier(active, 1)
-        logits, k, v, pos = self._decode_fn(
-            self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], np.asarray(tokens, np.int32), active,
-            self.pool.rows())
-        self._cache = {"k": k, "v": v, "pos": pos}
-        self._host_pos += active
-        # one counted fence per decode iteration (sampler dependency;
-        # the dispatch plan's predicted fence counter)
-        return np.asarray(obs_fences.read_arrays(logits)[0], np.float32)
+        self.decode_dispatches += 1
+        _RECORDER.record("serve_decode", dispatch=self.decode_dispatches,
+                         active=int(active.sum()))
+        with self._armed("serve_decode"), annotate("serve_decode"):
+            # chaos stall point: inside the armed region, so a stalled
+            # decode fires the serve watchdog and the dump names the
+            # chaos_stall frame (docs/resilience.md)
+            _chaos.maybe_stall(self.decode_dispatches)
+            logits, k, v, pos = self._decode_fn(
+                self.params, self._cache["k"], self._cache["v"],
+                self._cache["pos"], np.asarray(tokens, np.int32), active,
+                self.pool.rows())
+            self._cache = {"k": k, "v": v, "pos": pos}
+            self._host_pos += active
+            # one counted fence per decode iteration (sampler dependency;
+            # the dispatch plan's predicted fence counter)
+            return np.asarray(obs_fences.read_arrays(logits)[0],
+                              np.float32)
 
     def decode_many(self, tokens, active, eos_ids, remaining):
         """D fused decode iterations in ONE dispatch
@@ -1043,16 +1103,26 @@ class InferenceEngine:
                 "> 1 (the fused decode program was not built)")
         active = np.asarray(active, bool)
         self._ring_write_barrier(active, self.decode_iters_per_dispatch)
-        toks, emitted, kb, vb, pos, _active, _rem = self._decode_many_fn(
-            self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], np.asarray(tokens, np.int32),
-            active, np.asarray(eos_ids, np.int32),
-            np.asarray(remaining, np.int32), self.pool.rows(),
-            self._live_flag)
-        self._cache = {"k": kb, "v": vb, "pos": pos}
-        # the sampler fence, amortized: one counted read per D-block
-        # instead of one per token (dispatch plan prices it at 1/D)
-        out = obs_fences.read_arrays(toks, emitted)
+        self.decode_dispatches += 1
+        _RECORDER.record("serve_decode_many",
+                         dispatch=self.decode_dispatches,
+                         active=int(active.sum()),
+                         d=self.decode_iters_per_dispatch)
+        with self._armed("serve_decode_many",
+                         scale=float(self.decode_iters_per_dispatch)), \
+                annotate("serve_decode_many"):
+            _chaos.maybe_stall(self.decode_dispatches)
+            toks, emitted, kb, vb, pos, _active, _rem = \
+                self._decode_many_fn(
+                    self.params, self._cache["k"], self._cache["v"],
+                    self._cache["pos"], np.asarray(tokens, np.int32),
+                    active, np.asarray(eos_ids, np.int32),
+                    np.asarray(remaining, np.int32), self.pool.rows(),
+                    self._live_flag)
+            self._cache = {"k": kb, "v": vb, "pos": pos}
+            # the sampler fence, amortized: one counted read per D-block
+            # instead of one per token (dispatch plan prices it at 1/D)
+            out = obs_fences.read_arrays(toks, emitted)
         toks = np.asarray(out[0])
         emitted = np.asarray(out[1]).astype(bool)
         self._host_pos += emitted.sum(axis=0)
@@ -1069,18 +1139,28 @@ class InferenceEngine:
             raise RuntimeError(
                 "spec_decode needs inference.speculative.draft_tokens "
                 "> 0 (the speculative program was not built)")
-        toks, emitted, k, v, pos, kd, vd, _act, _rem = self._spec_fn(
-            self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], self.draft_params,
-            self._draft_cache["k"], self._draft_cache["v"],
-            self.pool.rows(), self._draft_rows,
-            np.asarray(tokens, np.int32), np.asarray(active, bool),
-            np.asarray(eos_ids, np.int32),
-            np.asarray(remaining, np.int32), self._live_flag)
-        self._cache = {"k": k, "v": v, "pos": pos}
-        self._draft_cache = {"k": kd, "v": vd,
-                             "pos": self._draft_cache["pos"]}
-        out = obs_fences.read_arrays(toks, emitted)
+        active = np.asarray(active, bool)
+        self.decode_dispatches += 1
+        _RECORDER.record("serve_spec_step",
+                         dispatch=self.decode_dispatches,
+                         active=int(active.sum()),
+                         j=self.spec_draft_tokens)
+        with self._armed("serve_spec_step",
+                         scale=float(self.spec_draft_tokens + 1)), \
+                annotate("serve_spec_step"):
+            _chaos.maybe_stall(self.decode_dispatches)
+            toks, emitted, k, v, pos, kd, vd, _act, _rem = self._spec_fn(
+                self.params, self._cache["k"], self._cache["v"],
+                self._cache["pos"], self.draft_params,
+                self._draft_cache["k"], self._draft_cache["v"],
+                self.pool.rows(), self._draft_rows,
+                np.asarray(tokens, np.int32), active,
+                np.asarray(eos_ids, np.int32),
+                np.asarray(remaining, np.int32), self._live_flag)
+            self._cache = {"k": k, "v": v, "pos": pos}
+            self._draft_cache = {"k": kd, "v": vd,
+                                 "pos": self._draft_cache["pos"]}
+            out = obs_fences.read_arrays(toks, emitted)
         toks = np.asarray(out[0])
         emitted = np.asarray(out[1]).astype(bool)
         self._host_pos += emitted.sum(axis=0)

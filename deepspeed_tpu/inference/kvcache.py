@@ -283,11 +283,14 @@ class PagePool:
         # mask zeroes — never trusted, never written
         self._table = np.zeros((spec.slots, spec.pages_per_slot), np.int32)
         self._rows = None            # cached [slots, capacity] row map
-        # cumulative telemetry (the serve v2 columns read these)
+        # cumulative telemetry (the serve v2/v3 columns read these)
         self.hits = 0
         self.tokens_reused = 0
         self.refusals = 0
         self.cow_copies = 0
+        # published LRU pages reclaimed by the allocator — each one is a
+        # cached prefix lost; the thrash detector watches the rate
+        self.lru_reclaims = 0
 
     # ------------------------------------------------------------ queries
     @property
@@ -308,6 +311,24 @@ class PagePool:
 
     def is_published(self, page: int) -> bool:
         return page in self._hash_of
+
+    def gauges(self) -> dict:
+        """Live pool state as flat numbers — the ``/metrics`` gauges and
+        the serve v3 window columns (docs/observability.md "Serving
+        view").  Pure host bookkeeping reads, no device interaction."""
+        return {
+            "pool_pages": self.num_pages,
+            "free_pages": self.free_pages,       # allocatable (free+LRU)
+            "lru_pages": len(self._lru),         # published, refcount 0
+            "published_pages": len(self._hash_of),
+            "pages_in_use": int(np.sum(self._ref > 0)),
+            "shared_pages": int(np.sum(self._ref > 1)),
+            "prefix_hits": self.hits,
+            "prefix_tokens_reused": self.tokens_reused,
+            "admission_refusals": self.refusals,
+            "cow_copies": self.cow_copies,
+            "lru_reclaims": self.lru_reclaims,
+        }
 
     def rows(self) -> np.ndarray:
         """The resolved ``[slots, capacity]`` int32 flat-row map the
@@ -341,6 +362,7 @@ class PagePool:
         if self._lru:
             page, _ = self._lru.popitem(last=False)    # oldest cached
             self._unpublish(page)
+            self.lru_reclaims += 1
             return page
         return None
 

@@ -44,14 +44,39 @@ class Request:
 
 @dataclasses.dataclass
 class RequestResult:
-    """Completed request: generated tokens + the latency facts the bench
-    aggregates (seconds, measured host-side at token delivery)."""
+    """Completed request: generated tokens + the whole lifecycle as
+    numbers (seconds, measured host-side at token delivery) — the
+    per-request record the summary percentiles and the
+    ``dstpu.telemetry.request`` events are derived from."""
     rid: int
     tokens: List[int]
     finish_reason: str                # "eos" | "length"
     ttft_s: Optional[float] = None    # enqueue -> first token
     itl_s: List[float] = dataclasses.field(default_factory=list)
     prompt_len: int = 0
+    # ---- lifecycle breakdown (PR 14): submit -> admit -> first token
+    # -> eviction, plus the admission's page-table facts
+    queue_wait_s: Optional[float] = None   # submit -> admission dispatch
+    prefill_s: Optional[float] = None      # admission dispatch -> 1st token
+    finished_ts: Optional[float] = None    # completion wall time
+    slot: Optional[int] = None             # decode slot served in
+    prefix_hit: bool = False               # admission reused shared pages
+    reused_tokens: int = 0                 # prompt tokens not re-prefilled
+    pages_mapped: int = 0                  # page-table entries mapped
+
+    @property
+    def decode_s(self) -> Optional[float]:
+        """First token -> last token (sum of inter-token gaps); None on a
+        one-token request."""
+        return sum(self.itl_s) if self.itl_s else None
+
+    @property
+    def itl_mean_s(self) -> Optional[float]:
+        """The request's mean inter-token gap — its ONE ITL sample in the
+        per-request percentiles.  Robust under fused decode: within a
+        D-block all but the first gap are honestly ~0, but the mean is
+        total decode time over tokens, comparable across D."""
+        return (sum(self.itl_s) / len(self.itl_s)) if self.itl_s else None
 
 
 def greedy_sampler(logits_row: np.ndarray) -> int:
@@ -71,23 +96,42 @@ def percentile(xs, p: float) -> Optional[float]:
 
 
 def latency_samples_ms(results):
-    """``(ttft_ms, itl_ms)`` sample lists over completed results — the
-    one owner of the seconds→ms aggregation (latency_summary AND the
-    serve telemetry windows read it)."""
+    """``(ttft_ms, itl_ms)`` pooled sample lists over completed results
+    (every per-token gap is one ITL sample).  Kept for the pooled
+    ``itl_mean_ms`` and raw-sample consumers; the summary PERCENTILES
+    come from :func:`request_latency_ms` — pooled per-token percentiles
+    are degenerate under fused decode (D-1 of every D gaps are ~0, so
+    the per-token p50 honestly collapses to 0 at D>1)."""
     return ([r.ttft_s * 1e3 for r in results if r.ttft_s is not None],
             [dt * 1e3 for r in results for dt in r.itl_s])
 
 
-def latency_summary(results, elapsed_s: float, n_chips: int = 1) -> dict:
-    """tokens/s(/chip) + p50/p99 TTFT and inter-token latency over a
-    completed trace (milliseconds, like the telemetry events).
+def request_latency_ms(results):
+    """``(ttft_ms, itl_ms, queue_wait_ms)`` PER-REQUEST sample lists —
+    one sample per completed request (a request's ITL sample is its mean
+    inter-token gap, :attr:`RequestResult.itl_mean_s`).  The one owner
+    of the summary/telemetry percentile inputs: percentiles over these
+    stay meaningful at any ``decode_iters_per_dispatch``."""
+    return ([r.ttft_s * 1e3 for r in results if r.ttft_s is not None],
+            [r.itl_mean_s * 1e3 for r in results
+             if r.itl_mean_s is not None],
+            [r.queue_wait_s * 1e3 for r in results
+             if r.queue_wait_s is not None])
 
-    ``itl_mean_ms`` is the D-fusion-robust ITL number: with
-    ``decode_iters_per_dispatch`` > 1 tokens arrive in bursts of D, so
-    D-1 of every D per-token gaps are honestly ~0 and the p50 collapses
-    — the MEAN still measures per-token cost and stays comparable
-    across D (docs/inference.md "Fused decode")."""
-    ttft, itl = latency_samples_ms(results)
+
+def latency_summary(results, elapsed_s: float, n_chips: int = 1) -> dict:
+    """tokens/s(/chip) + p50/p99 TTFT / inter-token latency / queue wait
+    over a completed trace (milliseconds, like the telemetry events).
+
+    Percentiles are PER-REQUEST (:func:`request_latency_ms`): each
+    completed request contributes one TTFT, one queue-wait and one
+    mean-ITL sample, so the tail measures slow REQUESTS — and stays
+    comparable across ``decode_iters_per_dispatch`` (the old pooled
+    per-token p50 read 0 at D>1).  ``itl_mean_ms`` remains the pooled
+    per-token mean, the cross-D throughput-per-token number
+    (docs/inference.md "Fused decode")."""
+    ttft, itl_req, queue_wait = request_latency_ms(results)
+    _, itl_pooled = latency_samples_ms(results)
     tokens = sum(len(r.tokens) for r in results)
     tps = tokens / elapsed_s if elapsed_s > 0 else None
     return {
@@ -99,9 +143,12 @@ def latency_summary(results, elapsed_s: float, n_chips: int = 1) -> dict:
                                     else round(tps / max(1, n_chips), 2)),
         "ttft_p50_ms": percentile(ttft, 50),
         "ttft_p99_ms": percentile(ttft, 99),
-        "itl_p50_ms": percentile(itl, 50),
-        "itl_p99_ms": percentile(itl, 99),
-        "itl_mean_ms": (round(float(np.mean(itl)), 4) if itl else None),
+        "itl_p50_ms": percentile(itl_req, 50),
+        "itl_p99_ms": percentile(itl_req, 99),
+        "itl_mean_ms": (round(float(np.mean(itl_pooled)), 4)
+                        if itl_pooled else None),
+        "queue_wait_p50_ms": percentile(queue_wait, 50),
+        "queue_wait_p99_ms": percentile(queue_wait, 99),
     }
 
 
@@ -138,10 +185,12 @@ class _Slot:
     """Host-side mirror of one decode slot."""
 
     __slots__ = ("req", "generated", "last_token", "t_enqueue", "t_last",
-                 "ttft", "itl")
+                 "ttft", "itl", "queue_wait", "prefill_s", "prefix_hit",
+                 "reused_tokens", "pages_mapped")
 
     def __init__(self, req: Request, first_token: int, t_enqueue: float,
-                 now: float):
+                 now: float, t_admit: Optional[float] = None,
+                 reused_tokens: int = 0, pages_mapped: int = 0):
         self.req = req
         self.generated = [first_token]
         self.last_token = first_token
@@ -149,6 +198,14 @@ class _Slot:
         self.t_last = now
         self.ttft = now - t_enqueue
         self.itl = []
+        # lifecycle breakdown: queue wait ends when the admission
+        # dispatch starts; prefill is dispatch -> first token
+        self.queue_wait = (t_admit - t_enqueue
+                           if t_admit is not None else None)
+        self.prefill_s = now - t_admit if t_admit is not None else None
+        self.prefix_hit = reused_tokens > 0
+        self.reused_tokens = int(reused_tokens)
+        self.pages_mapped = int(pages_mapped)
 
 
 class ContinuousScheduler:
@@ -161,10 +218,14 @@ class ContinuousScheduler:
     drain with :meth:`run`."""
 
     def __init__(self, engine, sampler: Callable = greedy_sampler,
-                 on_event: Optional[Callable] = None):
+                 on_event: Optional[Callable] = None,
+                 on_complete: Optional[Callable] = None):
         self.engine = engine
         self.sampler = sampler
         self.on_event = on_event          # telemetry hook (driver.py)
+        self.on_complete = on_complete    # per-request record hook:
+                                          # called with each RequestResult
+                                          # at eviction (request events)
         self.queue: List[tuple] = []      # (request, t_enqueue)
         self.slots: List[Optional[_Slot]] = [None] * engine.num_slots
         self.results: List[RequestResult] = []
@@ -207,6 +268,7 @@ class ContinuousScheduler:
             if not self.queue or self.slots[i] is not None:
                 continue
             req, t_enq = self.queue[0]
+            t_admit = time.perf_counter()
             res = eng.admit(i, req.prompt, req.max_new_tokens)
             if res is None:
                 self.admission_refusals += 1
@@ -218,7 +280,12 @@ class ContinuousScheduler:
                 self.prefix_hits += 1
                 self.prefix_tokens_reused += reused
             tok = self.sampler(logits)
-            self.slots[i] = _Slot(req, tok, t_enq, now)
+            pool = getattr(eng, "pool", None)
+            self.slots[i] = _Slot(
+                req, tok, t_enq, now, t_admit=t_admit,
+                reused_tokens=reused,
+                pages_mapped=(len(pool.slot_pages(i)) if pool is not None
+                              else 0))
             self.admitted += 1
             admitted_now += 1
             if _stops(req, tok, 1):
@@ -351,10 +418,17 @@ class ContinuousScheduler:
         # refcount-- on every page the slot mapped: shared pages survive
         # for their other readers / the LRU prefix cache
         self.engine.release(slot_idx)
-        self.results.append(RequestResult(
+        result = RequestResult(
             rid=s.req.rid, tokens=list(s.generated), finish_reason=reason,
             ttft_s=s.ttft, itl_s=list(s.itl),
-            prompt_len=len(s.req.prompt)))
+            prompt_len=len(s.req.prompt),
+            queue_wait_s=s.queue_wait, prefill_s=s.prefill_s,
+            finished_ts=time.time(), slot=slot_idx,
+            prefix_hit=s.prefix_hit, reused_tokens=s.reused_tokens,
+            pages_mapped=s.pages_mapped)
+        self.results.append(result)
+        if self.on_complete is not None:
+            self.on_complete(result)
 
     def run(self, requests=None, max_iters: int = 100000) -> list:
         """Drain: submit ``requests`` (optional) and iterate until every
@@ -399,10 +473,12 @@ class StaticScheduler:
             batch = requests[start:start + n_slots]
             slots = {}
             for i, req in enumerate(batch):
+                t_admit = time.perf_counter()
                 logits = eng.prefill(i, req.prompt)
                 now = time.perf_counter()
                 tok = self.sampler(logits)
-                slots[i] = _Slot(req, tok, enq[req.rid], now)
+                slots[i] = _Slot(req, tok, enq[req.rid], now,
+                                 t_admit=t_admit)
             done = {i: _stops(s.req, s.last_token, 1)
                     for i, s in slots.items()}
             while not all(done.values()):
@@ -431,5 +507,7 @@ class StaticScheduler:
                 self.results.append(RequestResult(
                     rid=s.req.rid, tokens=list(s.generated),
                     finish_reason=reason, ttft_s=s.ttft,
-                    itl_s=list(s.itl), prompt_len=len(s.req.prompt)))
+                    itl_s=list(s.itl), prompt_len=len(s.req.prompt),
+                    queue_wait_s=s.queue_wait, prefill_s=s.prefill_s,
+                    finished_ts=time.time(), slot=i))
         return self.results

@@ -1,13 +1,14 @@
 """JSONL event-log validator CLI.
 
 ``python -m deepspeed_tpu.observability <events.jsonl> [...]`` — validates
-every line of each telemetry event log.  Streams may interleave the four
+every line of each telemetry event log.  Streams may interleave the five
 event schemas (``dstpu.telemetry.window`` v1/v2, ``dstpu.telemetry.fleet``
-v2, ``dstpu.telemetry.startup`` v2, ``dstpu.telemetry.serve`` v1/v2 —
-observability/schema.py, each on its own version track); v1 window-only
-logs from before the fleet layer still validate, as do PR 10 serve logs
-without the v2 prefix-reuse/speculative columns.  The per-file summary
-is version-aware (``3 serve v2, 1 startup v2, …``).  Exit codes:
+v2, ``dstpu.telemetry.startup`` v2, ``dstpu.telemetry.serve`` v1/v2/v3,
+``dstpu.telemetry.request`` v1 — observability/schema.py, each on its own
+version track); v1 window-only logs from before the fleet layer still
+validate, as do PR 10/13 serve logs without the later columns.  The
+per-file summary is version-aware (``3 serve v3, 8 request v1, …``).
+Exit codes:
 0 = every file valid and non-empty, 2 = any problem — invalid lines,
 unknown schemas, unreadable or EMPTY files (the CI observability smoke
 job's gate, pinned by tests/test_fleet.py).  Needs no jax — it is a
@@ -26,7 +27,8 @@ def _summary(path: str) -> str:
     counts = schema.count_by_schema_version(path)
     short = {schema.SCHEMA_ID: "window", schema.FLEET_SCHEMA_ID: "fleet",
              schema.STARTUP_SCHEMA_ID: "startup",
-             schema.SERVE_SCHEMA_ID: "serve"}
+             schema.SERVE_SCHEMA_ID: "serve",
+             schema.REQUEST_SCHEMA_ID: "request"}
     parts = [f"{n} {short.get(sid, sid)}"
              + (f" v{version}" if version is not None else "")
              for (sid, version), n in sorted(counts.items(),
@@ -38,9 +40,10 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability",
         description="Validate telemetry JSONL event logs (schemas: "
-                    "%s v1/v2, %s v2, %s v2, %s v1/v2)" % (
+                    "%s v1/v2, %s v2, %s v2, %s v1/v2/v3, %s v1)" % (
                         schema.SCHEMA_ID, schema.FLEET_SCHEMA_ID,
-                        schema.STARTUP_SCHEMA_ID, schema.SERVE_SCHEMA_ID))
+                        schema.STARTUP_SCHEMA_ID, schema.SERVE_SCHEMA_ID,
+                        schema.REQUEST_SCHEMA_ID))
     parser.add_argument("paths", nargs="+", help="JSONL event log(s)")
     args = parser.parse_args(argv)
 

@@ -16,6 +16,14 @@ window drain already put on the host):
   rank's wall time slow (the healthy ranks just wait inside the
   collective), so wall time cannot name the culprit — host-side time can,
   because only the straggler spends it outside the device queue.
+* **serving detectors** (``ServeAnomalyDetector``, one per replica): over
+  each serve telemetry window — admission starvation (requests queued,
+  none admitted, pool refusals growing), speculative accept-rate collapse
+  (enough proposals, acceptance under the floor: the draft has drifted
+  from the target), and page-pool thrash (the prefix-cache LRU reclaiming
+  pages faster than it serves hits — cached prefixes churning before
+  reuse).  Same contract as the training detectors: one-shot warning,
+  counter, ``anomalies`` list on the window event.
 
 Everything is deterministic (median comparisons, explicit factors) so the
 chaos legs pin exact flaggings.
@@ -73,6 +81,110 @@ class DetectorCounters:
 
 
 COUNTERS = DetectorCounters()
+
+
+@dataclass
+class ServeDetectorCounters:
+    """Per-process serving-anomaly counters (exported through the serve
+    ``/metrics`` endpoint and every serve window event's ``counters``)."""
+    #: windows where queued requests starved (no admission, refusals grew)
+    serve_admission_starvation: int = 0
+    #: windows whose speculative accept rate collapsed under the floor
+    serve_accept_collapse: int = 0
+    #: windows where the prefix-cache LRU thrashed (reclaims > hits)
+    serve_pool_thrash: int = 0
+
+    def as_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    def reset(self) -> None:
+        for f in fields(self):
+            setattr(self, f.name, f.default)
+
+
+SERVE_COUNTERS = ServeDetectorCounters()
+
+
+class ServeAnomalyDetector:
+    """Per-replica anomaly detection over serve telemetry windows.
+
+    Deterministic window-delta checks (no baselines to poison): each
+    ``check_window`` call receives the window's ITERATION stats plus the
+    pool-gauge DELTAS since the previous window, and returns the anomaly
+    kinds — one-shot warning + counter per kind, exactly the training
+    detectors' contract."""
+
+    def __init__(self, starvation_windows: int = 1,
+                 accept_floor: float = 0.25, thrash_reclaims: int = 8,
+                 min_spec_proposals: int = 16):
+        self.starvation_windows = int(starvation_windows)
+        self.accept_floor = float(accept_floor)
+        self.thrash_reclaims = int(thrash_reclaims)
+        self.min_spec_proposals = int(min_spec_proposals)
+        self._starved_streak = 0
+        self._warned = set()
+
+    def _warn_once(self, kind: str, detail: str) -> None:
+        if kind in self._warned:
+            return
+        self._warned.add(kind)
+        logger.warning("serve telemetry: %s detected (%s) — further "
+                       "occurrences ride counters/events only",
+                       kind, detail)
+
+    def check_window(self, *, queue_depth: int, admitted: int,
+                     refusals_delta: int, spec_proposed_delta: int,
+                     spec_accepted_delta: int, lru_reclaims_delta: int,
+                     prefix_hits_delta: int) -> list:
+        """Anomaly kinds for one serve window (all inputs are this
+        window's deltas except ``queue_depth``, the live value at the
+        window edge)."""
+        anomalies = []
+        # admission starvation: requests are waiting, none got in, and
+        # the pool refused — ``starvation_windows`` consecutive windows
+        # of it is the flag (1 = flag immediately)
+        if (self.starvation_windows > 0 and queue_depth > 0
+                and admitted == 0 and refusals_delta > 0):
+            self._starved_streak += 1
+            if self._starved_streak >= self.starvation_windows:
+                anomalies.append("admission_starvation")
+                SERVE_COUNTERS.serve_admission_starvation += 1
+                self._warn_once(
+                    "admission_starvation",
+                    f"{queue_depth} queued, 0 admitted, "
+                    f"{refusals_delta} refusal(s) this window — raise "
+                    f"inference.pool_pages or add replicas")
+        else:
+            self._starved_streak = 0
+        # speculative accept-rate collapse: the draft stopped predicting
+        # the target (stale draft weights after a hot-swap, domain
+        # shift) — serving still EXACT but the speedup silently died
+        if (self.accept_floor > 0
+                and spec_proposed_delta >= self.min_spec_proposals):
+            rate = spec_accepted_delta / spec_proposed_delta
+            if rate < self.accept_floor:
+                anomalies.append("spec_accept_collapse")
+                SERVE_COUNTERS.serve_accept_collapse += 1
+                self._warn_once(
+                    "spec_accept_collapse",
+                    f"accept rate {rate:.3f} < floor "
+                    f"{self.accept_floor} over {spec_proposed_delta} "
+                    f"proposals — the draft model has drifted from the "
+                    f"target")
+        # page-pool thrash: the LRU reclaimed more published prefixes
+        # than it served hits — the cache churns before anything reuses
+        # it (pool too small for the working set of shared prefixes)
+        if (self.thrash_reclaims > 0
+                and lru_reclaims_delta >= self.thrash_reclaims
+                and lru_reclaims_delta > prefix_hits_delta):
+            anomalies.append("pool_thrash")
+            SERVE_COUNTERS.serve_pool_thrash += 1
+            self._warn_once(
+                "pool_thrash",
+                f"{lru_reclaims_delta} LRU reclaims vs "
+                f"{prefix_hits_delta} prefix hits this window — raise "
+                f"inference.pool_pages")
+        return anomalies
 
 
 def _median(values):

@@ -7,7 +7,7 @@ guessing.  Validation is hand-rolled — no jsonschema dependency — and
 doubles as the documentation of record for every field
 (docs/observability.md mirrors these tables).
 
-Four event schemas share one stream (a rank-0 log interleaves them):
+Five event schemas share one stream (a rank-0 log interleaves them):
 
 * ``dstpu.telemetry.window``  — one line per drained metric window.
   v1 (PR 7) logs still validate; v2 adds the per-host fleet-report
@@ -25,7 +25,15 @@ Four event schemas share one stream (a rank-0 log interleaves them):
   (deepspeed_tpu/inference/driver.py, docs/inference.md).  v1 (PR 10)
   logs still validate; v2 adds the prefix-reuse and speculative-decoding
   columns (``prefix_hits``, ``prefix_tokens_reused``, ``spec_proposed``,
-  ``spec_accepted``).
+  ``spec_accepted``); v3 adds the replica-observability columns (live
+  slot/page-pool gauges, per-window request completions, queue-wait
+  percentiles) and derives every latency percentile from per-request
+  records instead of the old cumulative per-token samples.
+* ``dstpu.telemetry.request`` — one line per COMPLETED serving request
+  (v1): the request's whole lifecycle as numbers — queue wait, prefill,
+  time-to-first-token, per-token decode latency, prefix-reuse facts
+  (pages mapped / tokens served from shared pages) and the finish
+  reason (docs/observability.md "Serving view").
 
 Schema evolution contract: additive fields bump the version with
 validators accepting all :data:`ACCEPTED_VERSIONS` and unknown EXTRA
@@ -54,9 +62,14 @@ STARTUP_SCHEMA_ID = "dstpu.telemetry.startup"
 #: future additive field bumps SERVE_ACCEPTED_VERSIONS without touching
 #: the training schemas.
 SERVE_SCHEMA_ID = "dstpu.telemetry.serve"
-SERVE_SCHEMA_VERSION = 2
-#: v1 = PR 10 logs (no prefix-reuse / speculative columns) — still valid
-SERVE_ACCEPTED_VERSIONS = (1, 2)
+SERVE_SCHEMA_VERSION = 3
+#: v1 = PR 10 logs (no prefix-reuse / speculative columns), v2 = PR 13
+#: logs (no replica-observability columns) — both still valid
+SERVE_ACCEPTED_VERSIONS = (1, 2, 3)
+
+#: per-request lifecycle records (one line per COMPLETED request)
+REQUEST_SCHEMA_ID = "dstpu.telemetry.request"
+REQUEST_SCHEMA_VERSION = 1
 
 _NUM = numbers.Real
 
@@ -175,7 +188,48 @@ SERVE_FIELDS = {
     "prefix_tokens_reused": (int, True, 2),  # prompt tokens not re-prefilled
     "spec_proposed": (int, True, 2),        # draft tokens proposed
     "spec_accepted": (int, True, 2),        # draft tokens accepted
+    # ---- v3 (replica observability): per-request-derived latency +
+    # live slot/page-pool gauges.  At v3 the ttft/itl percentile columns
+    # above are computed over PER-REQUEST records (each completed
+    # request is one sample; a request's ITL sample is its mean
+    # inter-token gap) instead of pooled per-token samples — the pooled
+    # per-token p50 honestly collapses to ~0 under fused decode (D-1 of
+    # every D gaps are within one dispatch).
+    "requests_completed": (int, True, 3),   # evictions in THIS window
+    "queue_wait_p50_ms": (_NUM, False, 3),  # over requests completed
+    "queue_wait_p99_ms": (_NUM, False, 3),  # so far (submit -> admit)
+    "itl_mean_ms": (_NUM, False, 3),        # pooled per-token mean (the
+                                            # cross-D-comparable number)
+    "slots_in_use": (int, True, 3),         # occupied slots at window end
+    "free_pages": (int, False, 3),          # allocatable (free + LRU)
+    "lru_pages": (int, False, 3),           # published refcount-0 pages
+    "shared_pages": (int, False, 3),        # pages with refcount > 1
+    "admission_refusals": (int, True, 3),   # cumulative pool refusals
     "counters": (dict, True),           # resilience/compile-cache roll-up
+}
+
+#: request event fields (schema ``dstpu.telemetry.request`` v1) — the
+#: per-request lifecycle record, emitted at eviction.  Milliseconds
+#: throughout; null = honestly unmeasured (e.g. ``itl_mean_ms`` of a
+#: one-token request).
+REQUEST_FIELDS = {
+    "schema": (str, True),
+    "version": (int, True),
+    "ts": (_NUM, True),                 # completion wall time
+    "rid": (int, True),                 # caller-assigned request id
+    "slot": (int, True),                # decode slot served in
+    "prompt_tokens": (int, True),
+    "tokens_out": (int, True),
+    "finish_reason": (str, True),       # "eos" | "length"
+    "queue_wait_ms": (_NUM, False),     # submit -> admission dispatch
+    "prefill_ms": (_NUM, False),        # admission dispatch -> first token
+    "ttft_ms": (_NUM, False),           # submit -> first token
+    "decode_ms": (_NUM, False),         # first token -> last token
+    "itl_mean_ms": (_NUM, False),       # decode_ms / (tokens_out - 1)
+    "itl_max_ms": (_NUM, False),        # largest single inter-token gap
+    "prefix_hit": (bool, True),         # admission reused shared pages
+    "prefix_tokens_reused": (int, True),  # prompt tokens not re-prefilled
+    "pages_mapped": (int, True),        # page-table entries this request
 }
 
 _SCHEMAS = None
@@ -189,6 +243,7 @@ def _schemas():
             FLEET_SCHEMA_ID: (FLEET_FIELDS, (2,)),
             STARTUP_SCHEMA_ID: (STARTUP_FIELDS, (2,)),
             SERVE_SCHEMA_ID: (SERVE_FIELDS, SERVE_ACCEPTED_VERSIONS),
+            REQUEST_SCHEMA_ID: (REQUEST_FIELDS, (1,)),
         }
     return _SCHEMAS
 
@@ -276,7 +331,8 @@ def validate_startup_event(event: dict) -> Optional[str]:
 
 
 def validate_serve_event(event: dict) -> Optional[str]:
-    """Validate a SERVE window event (continuous-batching telemetry)."""
+    """Validate a SERVE window event (continuous-batching telemetry;
+    v1/v2/v3 — the replica-observability columns are v3-only)."""
     if not isinstance(event, dict):
         return f"event is {type(event).__name__}, expected object"
     if event.get("schema") != SERVE_SCHEMA_ID:
@@ -291,7 +347,39 @@ def validate_serve_event(event: dict) -> Optional[str]:
         return f"slots must be >= 1, got {event['slots']}"
     if event["tokens_out"] < 0:
         return f"tokens_out must be >= 0, got {event['tokens_out']}"
+    if event["version"] >= 3:
+        if event["requests_completed"] < 0:
+            return (f"requests_completed must be >= 0, got "
+                    f"{event['requests_completed']}")
+        if not (0 <= event["slots_in_use"] <= event["slots"]):
+            return (f"slots_in_use ({event['slots_in_use']}) outside "
+                    f"[0, slots={event['slots']}]")
     return _validate_counters(event["counters"])
+
+
+def validate_request_event(event: dict) -> Optional[str]:
+    """Validate a per-request lifecycle record."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != REQUEST_SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{REQUEST_SCHEMA_ID!r}")
+    msg = _validate_fields(event, REQUEST_FIELDS, (1,))
+    if msg is not None:
+        return msg
+    if event["prompt_tokens"] < 1:
+        return (f"prompt_tokens must be >= 1, got "
+                f"{event['prompt_tokens']}")
+    if event["tokens_out"] < 1:
+        # a completed request emitted at least its first token
+        return f"tokens_out must be >= 1, got {event['tokens_out']}"
+    if event["finish_reason"] not in ("eos", "length"):
+        return (f"finish_reason must be 'eos' or 'length', got "
+                f"{event['finish_reason']!r}")
+    if not (0 <= event["prefix_tokens_reused"] <= event["prompt_tokens"]):
+        return (f"prefix_tokens_reused ({event['prefix_tokens_reused']}) "
+                f"outside [0, prompt_tokens={event['prompt_tokens']}]")
+    return None
 
 
 def _validate_counters(counters: dict) -> Optional[str]:
@@ -303,9 +391,10 @@ def _validate_counters(counters: dict) -> Optional[str]:
 
 
 def validate_any(event: dict) -> Optional[str]:
-    """Dispatch on the event's ``schema`` field: window (v1/v2), fleet and
-    startup events all validate; anything else is invalid — a stream of
-    unknown schemas must fail the gate, not slide through."""
+    """Dispatch on the event's ``schema`` field: window (v1/v2), fleet,
+    startup, serve (v1/v2/v3) and request events all validate; anything
+    else is invalid — a stream of unknown schemas must fail the gate,
+    not slide through."""
     if not isinstance(event, dict):
         return f"event is {type(event).__name__}, expected object"
     sid = event.get("schema")
@@ -317,9 +406,11 @@ def validate_any(event: dict) -> Optional[str]:
         return validate_startup_event(event)
     if sid == SERVE_SCHEMA_ID:
         return validate_serve_event(event)
+    if sid == REQUEST_SCHEMA_ID:
+        return validate_request_event(event)
     return (f"unknown schema {sid!r}; expected one of "
             f"[{SCHEMA_ID!r}, {FLEET_SCHEMA_ID!r}, {STARTUP_SCHEMA_ID!r}, "
-            f"{SERVE_SCHEMA_ID!r}]")
+            f"{SERVE_SCHEMA_ID!r}, {REQUEST_SCHEMA_ID!r}]")
 
 
 def validate_jsonl(path: str) -> list:

@@ -20,6 +20,15 @@ section, compiles one prefill + one decode program (graph-lint +
 memplan gated in error mode by the shipped config), and runs the
 request trace through the continuous-batching scheduler.  Exits
 nonzero if any request produced no tokens.
+
+Replica observability (docs/observability.md "Serving view"):
+``--health_port`` serves live /healthz /status /metrics;
+``--probe-endpoints`` probes them over real HTTP MID-TRAFFIC and
+parse-gates /metrics (the CI smoke leg); ``--watchdog_timeout_s`` arms
+the serve watchdog; ``--chaos-stall-iter N`` stalls the Nth decode
+dispatch and gates the watchdog-fire → 503 → loadable-dump chain;
+``--verify-identity`` re-serves the trace observability-off and
+requires bitwise-identical outputs + fence counts.
 """
 
 import os as _os
@@ -32,10 +41,52 @@ if _REPO_ROOT not in _sys.path:
 
 import argparse
 import json
+import threading
+import time
+import urllib.request
 
 import numpy as np
 
 VOCAB, SEQ = 512, 64
+
+
+class _EndpointProber(threading.Thread):
+    """Poll the replica's live endpoints over real HTTP while the serve
+    trace drains (the CI smoke's "curl /healthz and parse-gate /metrics
+    MID-TRAFFIC" leg, in-process so the timing is deterministic)."""
+
+    def __init__(self, port: int, interval_s: float = 0.05):
+        super().__init__(daemon=True, name="serve-endpoint-prober")
+        self.base = f"http://127.0.0.1:{port}"
+        self.interval_s = interval_s
+        self.stop = threading.Event()
+        self.healthz_codes = []
+        self.best_metrics = None     # parsed snapshot with most load
+        self.metrics_text = None
+        self.errors = []
+
+    def _get(self, path):
+        with urllib.request.urlopen(self.base + path, timeout=5) as r:
+            return r.getcode(), r.read().decode()
+
+    def run(self):
+        from deepspeed_tpu.observability.health import \
+            parse_prometheus_text
+        while not self.stop.is_set():
+            try:
+                code, _ = self._get("/healthz")
+                self.healthz_codes.append(code)
+                _, text = self._get("/metrics")
+                parsed = parse_prometheus_text(text)   # the parse gate
+                busy = parsed.get("dstpu_slots_in_use", 0)
+                if (self.best_metrics is None
+                        or busy >= self.best_metrics.get(
+                            "dstpu_slots_in_use", 0)):
+                    self.best_metrics = parsed
+                    self.metrics_text = text
+            except Exception as e:       # noqa: BLE001 - reported below
+                self.errors.append(str(e))
+            self.stop.wait(self.interval_s)
 
 
 def prepare(args):
@@ -65,14 +116,42 @@ def prepare(args):
     print(f"checkpoint: {path}")
 
 
+def _load_config(args) -> dict:
+    """Config dict with the CLI observability overrides applied
+    (--health_port / --watchdog_timeout_s ride the
+    inference.observability section; env DSTPU_HEALTH_PORT still works
+    as the fallback when neither is set).  An EXPLICIT 0 overrides a
+    config-enabled port/watchdog to off (the env fallback still
+    applies to port 0 — unset DSTPU_HEALTH_PORT for fully off)."""
+    with open(args.deepspeed_config) as f:
+        cfg = json.load(f)
+    obs = cfg.setdefault("inference", {}).setdefault("observability", {})
+    if args.health_port is not None:
+        obs["health_port"] = args.health_port
+    if args.watchdog_timeout_s is not None:
+        obs["watchdog_timeout_s"] = args.watchdog_timeout_s
+    return cfg
+
+
 def serve(args):
-    from deepspeed_tpu.inference import (InferenceEngine, run_serve,
-                                         synthetic_requests)
+    from deepspeed_tpu.inference import (InferenceEngine,
+                                         ServeObservability, observability,
+                                         run_serve, synthetic_requests)
     from deepspeed_tpu.models import GPT2
 
+    if args.chaos_stall_iter:
+        # deterministic stalled-decode chaos: the Nth decode dispatch
+        # stalls inside the watchdog-armed region until the watchdog
+        # reacted (ServeObservability wires stall_until to fire_event)
+        from deepspeed_tpu.resilience import chaos
+        chaos.configure(stall_step=args.chaos_stall_iter,
+                        stall_s=args.chaos_stall_s)
+
     model = GPT2.from_size(args.size, vocab_size=VOCAB, max_seq_len=SEQ)
-    engine = InferenceEngine(model, config=args.deepspeed_config,
-                             checkpoint_dir=args.ckpt)
+    cfg = _load_config(args)
+    engine = InferenceEngine(model, config=cfg, checkpoint_dir=args.ckpt)
+    obs = (ServeObservability(engine)
+           if observability.configured(engine.config) else None)
     print(f"serving tag {engine.loaded_tag}: {engine.num_slots} slots x "
           f"{engine.cache_spec.capacity} tokens "
           f"({engine.cache_spec.layout}), restore "
@@ -100,8 +179,31 @@ def serve(args):
             args.requests, vocab=VOCAB, seed=1, prompt_min=4,
             prompt_max=min(16, engine.prefill_bucket),
             new_min=4, new_max=args.max_new)
+
+    prober = None
+    if args.probe_endpoints:
+        if obs is None or obs.port is None:
+            print("ERROR: --probe-endpoints needs --health_port (or "
+                  "DSTPU_HEALTH_PORT)", file=_sys.stderr)
+            return 1
+        prober = _EndpointProber(obs.port)
+        prober.start()
+
+    from deepspeed_tpu.observability import fences
+    fences_before = fences.FENCE_COUNT
     out = run_serve(engine, reqs, jsonl_path=args.jsonl,
-                    window_iters=args.window)
+                    window_iters=args.window, observability=obs)
+    obs_fence_delta = fences.FENCE_COUNT - fences_before
+
+    rc = 0
+    if prober is not None:
+        prober.stop.set()
+        prober.join(timeout=5)
+        rc = max(rc, _check_probes(args, prober))
+    if args.chaos_stall_iter:
+        rc = max(rc, _check_chaos(obs))
+    if obs is not None:
+        obs.close()
 
     if args.prefix_trace and engine.prefix_reuse \
             and not out["summary"]["prefix_hit_rate"]:
@@ -117,6 +219,120 @@ def serve(args):
         print(f"ERROR: requests {empty} generated no tokens",
               file=_sys.stderr)
         return 1
+
+    if args.verify_identity:
+        rc = max(rc, _verify_identity(args, reqs, out, obs_fence_delta))
+    return rc
+
+
+def _check_probes(args, prober) -> int:
+    """Gate the mid-traffic endpoint probes: /healthz answered 200,
+    /metrics parsed (parse_prometheus_text already gated every probe)
+    with nonzero slot/page gauges at peak load."""
+    if not prober.healthz_codes:
+        print(f"ERROR: no successful /healthz probe "
+              f"(errors: {prober.errors[:3]})", file=_sys.stderr)
+        return 1
+    if not all(c == 200 for c in prober.healthz_codes):
+        print(f"ERROR: /healthz returned non-200 mid-serve: "
+              f"{sorted(set(prober.healthz_codes))}", file=_sys.stderr)
+        return 1
+    m = prober.best_metrics or {}
+    checks = {"dstpu_slots_in_use": 1, "dstpu_pool_pages_in_use": 1,
+              "dstpu_healthy": 1}
+    bad = {k: m.get(k) for k, v in checks.items()
+           if not (m.get(k) or 0) >= v}
+    if bad:
+        print(f"ERROR: mid-traffic /metrics gauges not live: {bad}",
+              file=_sys.stderr)
+        return 1
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(prober.metrics_text or "")
+    print(f"endpoints: {len(prober.healthz_codes)} mid-traffic probes, "
+          f"peak slots_in_use={m.get('dstpu_slots_in_use')}, "
+          f"pages_in_use={m.get('dstpu_pool_pages_in_use')}")
+    return 0
+
+
+def _check_chaos(obs) -> int:
+    """Gate the stalled-decode chaos leg: the serve watchdog fired,
+    /healthz now answers 503, and the flight-recorder dump is loadable
+    and names the stalled decode dispatch."""
+    from deepspeed_tpu.observability import flightrec
+    if obs is None or obs.watchdog is None:
+        print("ERROR: --chaos-stall-iter needs --watchdog_timeout_s",
+              file=_sys.stderr)
+        return 1
+    if not obs.watchdog.fired:
+        print("ERROR: chaos stall did not fire the serve watchdog",
+              file=_sys.stderr)
+        return 1
+    if obs.port is not None:
+        import urllib.error
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{obs.port}/healthz",
+                    timeout=5) as r:
+                code = r.getcode()
+        except urllib.error.HTTPError as e:
+            code = e.code
+        if code != 503:
+            print(f"ERROR: /healthz returned {code} after the watchdog "
+                  f"fired (expected 503)", file=_sys.stderr)
+            return 1
+    path = _os.path.join(flightrec.RECORDER.resolve_dump_dir(),
+                         f"flightrec_rank{flightrec.RECORDER.rank}"
+                         f"_watchdog.json")
+    try:
+        payload = flightrec.load_dump(path)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: watchdog flight-recorder dump missing/invalid "
+              f"({path}): {e}", file=_sys.stderr)
+        return 1
+    kinds = [e.get("kind") for e in payload["entries"]]
+    if not any(str(k).startswith("serve_decode") for k in kinds):
+        print(f"ERROR: dump does not name the stalled decode "
+              f"(kinds: {sorted(set(kinds))})", file=_sys.stderr)
+        return 1
+    print(f"chaos: watchdog fired, /healthz 503, dump {path} names "
+          f"the stalled decode dispatch")
+    return 0
+
+
+def _verify_identity(args, reqs, out, obs_fence_delta) -> int:
+    """Re-serve the SAME trace with observability stripped and pin
+    bitwise-identical greedy outputs + an identical deliberate-fence
+    count — observability must be trajectory-neutral."""
+    import copy
+
+    from deepspeed_tpu.inference import InferenceEngine, run_serve
+    from deepspeed_tpu.models import GPT2
+    from deepspeed_tpu.observability import fences
+
+    cfg = _load_config(args)
+    cfg.get("inference", {}).pop("observability", None)
+    model = GPT2.from_size(args.size, vocab_size=VOCAB, max_seq_len=SEQ)
+    engine = InferenceEngine(model, config=cfg, checkpoint_dir=args.ckpt)
+    f0 = fences.FENCE_COUNT
+    base = run_serve(engine, copy.deepcopy(reqs), window_iters=args.window)
+    base_fences = fences.FENCE_COUNT - f0
+    obs_tokens = {r.rid: r.tokens for r in out["results"]}
+    base_tokens = {r.rid: r.tokens for r in base["results"]}
+    if obs_tokens != base_tokens:
+        diff = [rid for rid in obs_tokens
+                if obs_tokens[rid] != base_tokens.get(rid)]
+        print(f"ERROR: observability changed greedy outputs for "
+              f"requests {diff}", file=_sys.stderr)
+        return 1
+    if base_fences != obs_fence_delta:
+        print(f"ERROR: observability changed the deliberate-fence count "
+              f"({obs_fence_delta} with, {base_fences} without)",
+              file=_sys.stderr)
+        return 1
+    print(f"identity: {len(base_tokens)} requests bitwise-identical "
+          f"with observability off ({base_fences} deliberate fences "
+          f"either way)")
     return 0
 
 
@@ -146,6 +362,35 @@ def main():
                         help="decode iterations per serve telemetry event")
     parser.add_argument("--jsonl", default=None,
                         help="serve telemetry JSONL path")
+    parser.add_argument("--health_port", type=int, default=None,
+                        help="serve /healthz /status /metrics on this "
+                             "port (unset = the config/env value; an "
+                             "explicit 0 disables a config-enabled "
+                             "port)")
+    parser.add_argument("--watchdog_timeout_s", type=float, default=None,
+                        help="arm the serve watchdog around every "
+                             "prefill/decode dispatch (explicit 0 "
+                             "disables a config-enabled watchdog)")
+    parser.add_argument("--probe-endpoints", action="store_true",
+                        help="probe /healthz + parse-gate /metrics over "
+                             "HTTP mid-traffic; exits 1 unless the "
+                             "slot/page gauges went live")
+    parser.add_argument("--metrics-out", default=None,
+                        help="write the peak-load /metrics payload here "
+                             "(CI artifact)")
+    parser.add_argument("--chaos-stall-iter", type=int, default=0,
+                        help="stall the Nth decode dispatch inside the "
+                             "armed watchdog region (chaos leg); exits "
+                             "1 unless the watchdog fired, /healthz "
+                             "turned 503 and a loadable dump names the "
+                             "stalled decode")
+    parser.add_argument("--chaos-stall-s", type=float, default=30.0,
+                        help="stall duration ceiling (ends early when "
+                             "the watchdog reacted)")
+    parser.add_argument("--verify-identity", action="store_true",
+                        help="re-serve the trace observability-off and "
+                             "require bitwise-identical outputs + fence "
+                             "count")
     args = parser.parse_args()
     VOCAB, SEQ = args.vocab, args.seq
 

@@ -529,7 +529,7 @@ def test_serve_summary_and_v2_events(tmp_path):
     import json
     serve = [json.loads(l) for l in open(path)
              if json.loads(l).get("schema") == schema.SERVE_SCHEMA_ID]
-    assert serve and serve[-1]["version"] == 2
+    assert serve and serve[-1]["version"] == schema.SERVE_SCHEMA_VERSION
     assert serve[-1]["prefix_hits"] == 3
     assert serve[-1]["prefix_tokens_reused"] == 48
     assert serve[-1]["spec_proposed"] > 0

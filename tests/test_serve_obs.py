@@ -1,0 +1,591 @@
+"""Serving-replica observability (docs/observability.md "Serving view").
+
+The load-bearing pins:
+
+* **Trajectory neutrality** — greedy outputs and the deliberate-fence
+  counter are IDENTICAL with the full observability stack on or off
+  (request events, watchdog, detectors, endpoints are host-side only).
+* **Per-request records** — one validator-clean
+  ``dstpu.telemetry.request`` line per completed request, with the
+  lifecycle breakdown consistent (ttft ≈ queue wait + prefill).
+* **Per-request percentiles** — ``latency_summary``'s p50/p99 are
+  derived from per-request records, so they no longer collapse to 0
+  under fused decode (the old pooled per-token design's documented
+  failure at D>1).
+* **Schema evolution** — serve v1/v2 logs still validate next to v3 +
+  request streams; the validator CLI exit-2 contract stays pinned.
+* **Hang capture** — a stalled decode fires the serve watchdog:
+  ``/healthz`` turns 503 (the fleet router's eviction signal) and a
+  loadable flight-recorder dump names the stalled decode dispatch.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import (ContinuousScheduler, InferenceEngine,
+                                     Request, ServeObservability,
+                                     ServeTelemetry, kvcache, observability,
+                                     run_serve, synthetic_requests)
+from deepspeed_tpu.inference.scheduler import (RequestResult,
+                                               latency_summary)
+from deepspeed_tpu.models.gpt2 import GPT2
+from deepspeed_tpu.observability import detectors, fences, flightrec, schema
+from deepspeed_tpu.observability.health import (HealthServer,
+                                                parse_prometheus_text)
+from deepspeed_tpu.resilience import chaos
+
+TINY = dict(vocab_size=128, max_seq_len=64, num_layers=2, hidden_size=64,
+            num_heads=4)
+
+
+def tiny_model():
+    return GPT2.from_size("tiny", **TINY)
+
+
+def serve_config(obs=None, **inf):
+    base = {"max_slots": 3, "max_tokens": 32, "prefill_bucket": 16,
+            "page_tokens": 32, "dtype": "float32"}
+    base.update(inf)
+    if obs is not None:
+        base["observability"] = obs
+    return {"train_micro_batch_size_per_gpu": 1, "inference": base}
+
+
+def trace(n=5, seed=3):
+    return synthetic_requests(n, vocab=TINY["vocab_size"], seed=seed,
+                              prompt_min=3, prompt_max=10, new_min=3,
+                              new_max=7)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    chaos.reset()
+    detectors.SERVE_COUNTERS.reset()
+    yield
+    chaos.reset()
+    detectors.SERVE_COUNTERS.reset()
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+
+
+# --------------------------------------------------------------- requests
+
+def test_request_events_emitted_and_valid(eng, tmp_path):
+    jsonl = str(tmp_path / "serve.jsonl")
+    eng.reset()
+    out = run_serve(eng, trace(), jsonl_path=jsonl, window_iters=3)
+    assert schema.validate_jsonl(jsonl) == []
+    events = [json.loads(l) for l in open(jsonl)]
+    reqs = [e for e in events if e["schema"] == schema.REQUEST_SCHEMA_ID]
+    results = {r.rid: r for r in out["results"]}
+    assert len(reqs) == len(results) == 5
+    assert out["summary"]["request_events"] == 5
+    for e in reqs:
+        r = results[e["rid"]]
+        assert e["tokens_out"] == len(r.tokens)
+        assert e["prompt_tokens"] == r.prompt_len
+        assert e["finish_reason"] in ("eos", "length")
+        assert e["queue_wait_ms"] >= 0
+        assert e["prefill_ms"] > 0
+        # the lifecycle adds up: submit -> admit -> first token
+        assert e["ttft_ms"] == pytest.approx(
+            e["queue_wait_ms"] + e["prefill_ms"], rel=0.05, abs=1.0)
+        assert e["pages_mapped"] >= 1
+        assert e["prefix_hit"] is False       # prompts < one page
+    serves = [e for e in events if e["schema"] == schema.SERVE_SCHEMA_ID]
+    assert all(e["version"] == 3 for e in serves)
+    # windows account for every completion exactly once
+    assert sum(e["requests_completed"] for e in serves) == 5
+
+
+def test_request_events_opt_out(eng, tmp_path):
+    jsonl = str(tmp_path / "serve.jsonl")
+    eng.reset()
+    tel = ServeTelemetry(eng, jsonl_path=jsonl, window_iters=4,
+                         request_events=False)
+    sched = ContinuousScheduler(eng, on_event=tel.on_iteration,
+                                on_complete=tel.on_complete)
+    sched.run(trace(3))
+    tel.flush(sched)
+    tel.close()
+    events = [json.loads(l) for l in open(jsonl)]
+    assert not [e for e in events
+                if e["schema"] == schema.REQUEST_SCHEMA_ID]
+    assert tel.request_events_emitted == 0
+
+
+def test_serve_window_v3_gauges(eng, tmp_path):
+    jsonl = str(tmp_path / "serve.jsonl")
+    eng.reset()
+    run_serve(eng, trace(4), jsonl_path=jsonl, window_iters=2)
+    serves = [json.loads(l) for l in open(jsonl)]
+    serves = [e for e in serves if e["schema"] == schema.SERVE_SCHEMA_ID]
+    assert serves
+    pool = eng.cache_spec.num_pages
+    for e in serves:
+        assert 0 <= e["slots_in_use"] <= e["slots"]
+        assert 0 <= e["free_pages"] <= pool
+        assert e["lru_pages"] >= 0 and e["shared_pages"] >= 0
+        assert e["admission_refusals"] == 0
+        # the serve detector counters ride the event's counter roll-up
+        assert "serve_admission_starvation" in e["counters"]
+    # mid-run windows saw occupied slots
+    assert max(e["slots_in_use"] for e in serves) >= 1 \
+        or max(e["active_slots_mean"] for e in serves) > 0
+
+
+# ----------------------------------------------- per-request percentiles
+
+def _result(rid, itl_s, ttft_s=0.01, queue_wait_s=0.002):
+    return RequestResult(
+        rid=rid, tokens=list(range(len(itl_s) + 1)),
+        finish_reason="length", ttft_s=ttft_s, itl_s=list(itl_s),
+        prompt_len=4, queue_wait_s=queue_wait_s, prefill_s=0.008,
+        finished_ts=0.0, slot=0)
+
+
+def test_summary_percentiles_are_per_request():
+    """The documented D>1 failure: tokens arrive in bursts, so D-1 of
+    every D pooled per-token gaps are exactly 0 and the pooled p50 reads
+    0.  Per-request mean-ITL samples keep the percentile meaningful."""
+    # 8 requests, each decoded in D=4 bursts: gaps [0, 0, 0, 40ms] x 2
+    results = [_result(i, [0.0, 0.0, 0.0, 0.04] * 2) for i in range(8)]
+    s = latency_summary(results, elapsed_s=1.0)
+    # pooled per-token p50 would be 0.0 — the per-request p50 is the
+    # mean gap, 10 ms
+    assert s["itl_p50_ms"] == pytest.approx(10.0)
+    assert s["itl_p99_ms"] == pytest.approx(10.0)
+    # the pooled mean survives as the cross-D number
+    assert s["itl_mean_ms"] == pytest.approx(10.0)
+    assert s["queue_wait_p50_ms"] == pytest.approx(2.0)
+    assert s["queue_wait_p99_ms"] == pytest.approx(2.0)
+
+
+def test_summary_handles_single_token_requests():
+    results = [_result(0, []), _result(1, [0.02, 0.02])]
+    s = latency_summary(results, elapsed_s=1.0)
+    # the one-token request contributes no ITL sample, but keeps its
+    # TTFT/queue-wait samples
+    assert s["itl_p50_ms"] == pytest.approx(20.0)
+    assert s["requests"] == 2
+    empty = latency_summary([], elapsed_s=0.0)
+    assert empty["itl_p50_ms"] is None
+    assert empty["queue_wait_p99_ms"] is None
+
+
+# ------------------------------------------------------ schema evolution
+
+def _serve_event_v(version):
+    base = {
+        "schema": schema.SERVE_SCHEMA_ID, "version": version, "ts": 1.0,
+        "window": 1, "decode_iters": 4, "tokens_out": 9, "admitted": 2,
+        "evicted": 1, "active_slots_mean": 1.5, "queue_depth": 0,
+        "slots": 4, "kv_cache_gb": 0.01, "tokens_per_sec": 100.0,
+        "ttft_p50_ms": 5.0, "ttft_p99_ms": 9.0, "itl_p50_ms": 1.0,
+        "itl_p99_ms": 2.0, "counters": {},
+    }
+    if version >= 2:
+        base.update({"prefix_hits": 0, "prefix_tokens_reused": 0,
+                     "spec_proposed": 0, "spec_accepted": 0})
+    if version >= 3:
+        base.update({"requests_completed": 1, "queue_wait_p50_ms": 0.5,
+                     "queue_wait_p99_ms": 0.9, "itl_mean_ms": 1.1,
+                     "slots_in_use": 2, "free_pages": 3, "lru_pages": 0,
+                     "shared_pages": 0, "admission_refusals": 0})
+    return base
+
+
+def _request_event(**over):
+    e = {
+        "schema": schema.REQUEST_SCHEMA_ID, "version": 1, "ts": 1.0,
+        "rid": 0, "slot": 1, "prompt_tokens": 4, "tokens_out": 3,
+        "finish_reason": "length", "queue_wait_ms": 0.5,
+        "prefill_ms": 2.0, "ttft_ms": 2.5, "decode_ms": 4.0,
+        "itl_mean_ms": 2.0, "itl_max_ms": 3.0, "prefix_hit": False,
+        "prefix_tokens_reused": 0, "pages_mapped": 1,
+    }
+    e.update(over)
+    return e
+
+
+def test_serve_v1_v2_logs_still_validate():
+    assert schema.validate_any(_serve_event_v(1)) is None
+    assert schema.validate_any(_serve_event_v(2)) is None
+    assert schema.validate_any(_serve_event_v(3)) is None
+    # v3 requires the new columns; v1/v2 must not
+    bad = _serve_event_v(3)
+    del bad["slots_in_use"]
+    assert "slots_in_use" in schema.validate_any(bad)
+    bad = _serve_event_v(3)
+    bad["slots_in_use"] = 9            # > slots
+    assert "slots_in_use" in schema.validate_any(bad)
+
+
+def test_request_event_schema_negatives():
+    assert schema.validate_any(_request_event()) is None
+    assert "finish_reason" in schema.validate_any(
+        _request_event(finish_reason="timeout"))
+    assert "tokens_out" in schema.validate_any(
+        _request_event(tokens_out=0))
+    assert "prefix_tokens_reused" in schema.validate_any(
+        _request_event(prefix_tokens_reused=99))
+    bad = _request_event()
+    del bad["pages_mapped"]
+    assert "pages_mapped" in schema.validate_any(bad)
+    # unmeasured latency columns are null, not missing
+    assert schema.validate_any(
+        _request_event(itl_mean_ms=None, decode_ms=None)) is None
+
+
+def test_validator_cli_mixed_serve_stream(tmp_path):
+    """Mixed v1/v2/v3 serve + request + startup stream: validator-clean
+    with a version-aware summary; unknown schema stays exit 2."""
+    good = tmp_path / "mixed.jsonl"
+    startup = {"schema": schema.STARTUP_SCHEMA_ID, "version": 2,
+               "ts": 1.0, "rank": 0, "host": "h", "step": 0,
+               "time_to_first_step_s": 1.0, "first_dispatch_s": 0.5,
+               "restore_seconds": 0.1, "compile_cache_hits": 0,
+               "compile_cache_misses": 2}
+    events = [startup, _serve_event_v(1), _serve_event_v(2),
+              _serve_event_v(3), _request_event()]
+    good.write_text("".join(json.dumps(e) + "\n" for e in events))
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.observability", str(good)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "request" in proc.stdout and "serve" in proc.stdout
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"schema": "dstpu.telemetry.bogus",
+                               "version": 1}) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.observability", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.observability", str(empty)],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+# ------------------------------------------------------- live endpoints
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.getcode(), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_health_endpoints_mid_serve(tmp_path):
+    cfg = serve_config(obs={"watchdog_timeout_s": 30.0,
+                            "window_iters": 2})
+    engine = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    obs = ServeObservability(engine)
+    assert obs.watchdog is not None and engine.watchdog is obs.watchdog
+    obs.health = HealthServer(0, obs)      # OS-assigned test port
+    try:
+        tel = ServeTelemetry(engine,
+                             jsonl_path=str(tmp_path / "s.jsonl"),
+                             window_iters=2, observability=obs)
+        obs.telemetry = tel
+        sched = ContinuousScheduler(engine, on_event=tel.on_iteration,
+                                    on_complete=tel.on_complete)
+        obs.note_scheduler(sched)
+        for r in trace(4, seed=5):
+            sched.submit(r)
+        for _ in range(3):                 # mid-serve: slots occupied
+            tel.on_iteration(sched, sched.step())
+        assert sched.active >= 1
+        code, body = _get(obs.port, "/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+        code, body = _get(obs.port, "/status")
+        status = json.loads(body)
+        assert code == 200
+        assert status["slots_in_use"] >= 1
+        assert status["pool"]["pages_in_use"] >= 1
+        assert status["healthy"] is True
+        code, text = _get(obs.port, "/metrics")
+        assert code == 200
+        parsed = parse_prometheus_text(text)     # the CI parse gate
+        assert parsed["dstpu_healthy"] == 1
+        assert parsed["dstpu_slots_in_use"] >= 1
+        assert parsed["dstpu_pool_pages_in_use"] >= 1
+        assert parsed["dstpu_slots_total"] == engine.num_slots
+        # drain and check the window-derived gauges appear
+        while sched.queue or sched.active:
+            tel.on_iteration(sched, sched.step())
+        tel.flush(sched)
+        tel.close()
+        _, text = _get(obs.port, "/metrics")
+        parsed = parse_prometheus_text(text)
+        assert parsed["dstpu_requests_completed"] == 4
+        assert "dstpu_window_tokens_per_sec" in parsed
+        assert "dstpu_window_queue_wait_p99_ms" in parsed
+    finally:
+        obs.close()
+
+
+def test_health_endpoints_from_config_port(tmp_path):
+    """inference.observability.health_port (and the env fallback) wires
+    the server up through run_serve without any explicit driver."""
+    port = int(os.environ.get("DSTPU_TEST_SERVE_PORT", "8965"))
+    cfg = serve_config(obs={"health_port": port})
+    engine = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    assert observability.configured(engine.config)
+    obs = ServeObservability(engine)
+    try:
+        assert obs.port == port          # + process_index 0
+        code, _ = _get(obs.port, "/healthz")
+        assert code == 200
+    finally:
+        obs.close()
+
+
+# ------------------------------------------------- hang capture + chaos
+
+def test_stalled_decode_watchdog_503_dump(tmp_path):
+    """The CI chaos leg's contract, in-process: a stalled decode fires
+    the serve watchdog, /healthz flips to 503, the dump is loadable and
+    names the stalled decode dispatch — and the outputs still match a
+    clean run (a stall is wall-clock, not numerics)."""
+    reqs = trace(3, seed=9)
+    clean = InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+    clean_out = run_serve(clean, [Request(rid=r.rid,
+                                          prompt=list(r.prompt),
+                                          max_new_tokens=r.max_new_tokens)
+                                  for r in reqs])
+    clean_tokens = {r.rid: r.tokens for r in clean_out["results"]}
+
+    flightrec.RECORDER.configure(dump_dir=str(tmp_path))
+    chaos.configure(stall_step=2, stall_s=30.0)
+    cfg = serve_config(obs={"watchdog_timeout_s": 0.3,
+                            "flight_recorder_dir": str(tmp_path)})
+    engine = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    obs = ServeObservability(engine)
+    obs.health = HealthServer(0, obs)
+    try:
+        # the stall ends when the watchdog reacted (wired by the driver)
+        assert chaos._state.stall_until is obs.watchdog.fire_event
+        out = run_serve(engine, reqs, observability=obs)
+        assert obs.watchdog.fired
+        assert not obs.healthy()
+        code, body = _get(obs.port, "/healthz")
+        assert code == 503 and json.loads(body)["ok"] is False
+        _, text = _get(obs.port, "/metrics")
+        assert parse_prometheus_text(text)["dstpu_healthy"] == 0
+        path = os.path.join(str(tmp_path),
+                            "flightrec_rank0_watchdog.json")
+        payload = flightrec.load_dump(path)
+        kinds = [e.get("kind") for e in payload["entries"]]
+        assert any(str(k).startswith("serve_decode") for k in kinds)
+        # the hang changed nothing about the tokens
+        assert {r.rid: r.tokens for r in out["results"]} == clean_tokens
+    finally:
+        obs.close()
+
+
+def test_serve_crash_dumps_flight_recorder(tmp_path):
+    """Satellite: the serving driver's crash exit rides the same dump
+    hook as the training driver's — a mid-drain exception leaves a
+    loadable ``flightrec_rank<r>_crash.json``."""
+    flightrec.RECORDER.configure(dump_dir=str(tmp_path))
+    engine = InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+    calls = []
+
+    def exploding_sampler(row):
+        calls.append(1)
+        if len(calls) >= 3:
+            raise RuntimeError("boom mid-drain")
+        return int(np.argmax(row))
+
+    with pytest.raises(RuntimeError, match="boom mid-drain"):
+        run_serve(engine, trace(3, seed=11), sampler=exploding_sampler)
+    payload = flightrec.load_dump(
+        os.path.join(str(tmp_path), "flightrec_rank0_crash.json"))
+    crash = [e for e in payload["entries"] if e["kind"] == "crash"]
+    assert crash and crash[-1]["where"] == "serve"
+
+
+def test_flight_recorder_dir_wins_without_driver(tmp_path):
+    """A configured ``flight_recorder_dir`` must place serve
+    post-mortems even when NO ServeObservability is built (no health
+    port, no watchdog) and the JSONL log lives elsewhere — the one
+    shared resolver in inference/observability.py."""
+    dumps = tmp_path / "dumps"
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    flightrec.RECORDER.configure(dump_dir=None)
+    cfg = serve_config(obs={"flight_recorder_dir": str(dumps),
+                            "jsonl_path": str(logs / "serve.jsonl")})
+    engine = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    assert not observability.configured(engine.config)
+
+    def boom(row):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_serve(engine, trace(2, seed=13), sampler=boom)
+    payload = flightrec.load_dump(
+        os.path.join(str(dumps), "flightrec_rank0_crash.json"))
+    assert payload["reason"] == "crash"
+
+
+# -------------------------------------------------- trajectory neutrality
+
+def test_observability_trajectory_neutral(tmp_path):
+    """Greedy outputs AND the deliberate-fence count are identical with
+    the full stack on (request events + JSONL + watchdog + detectors)
+    vs everything off — the acceptance contract, and what keeps the
+    dispatch-cost pass's FENCE_COUNT prediction exact either way."""
+    reqs = trace(6, seed=21)
+
+    def clone():
+        return [Request(rid=r.rid, prompt=list(r.prompt),
+                        max_new_tokens=r.max_new_tokens) for r in reqs]
+
+    plain = InferenceEngine(tiny_model(), config=serve_config(), seed=0)
+    f0 = fences.FENCE_COUNT
+    base = run_serve(plain, clone())
+    base_fences = fences.FENCE_COUNT - f0
+
+    cfg = serve_config(obs={"watchdog_timeout_s": 30.0,
+                            "window_iters": 2})
+    engine = InferenceEngine(tiny_model(), config=cfg, seed=0)
+    f0 = fences.FENCE_COUNT
+    obs_out = run_serve(engine, clone(),
+                        jsonl_path=str(tmp_path / "s.jsonl"),
+                        window_iters=2)
+    obs_fences = fences.FENCE_COUNT - f0
+
+    assert ({r.rid: r.tokens for r in obs_out["results"]}
+            == {r.rid: r.tokens for r in base["results"]})
+    assert obs_fences == base_fences
+    # and the dispatch plan's prediction still matches reality: the
+    # observability stack added zero executables to the promised set
+    pred = engine.predict_executables()
+    assert pred.total == plain.predict_executables().total
+
+
+# ------------------------------------------------------------- detectors
+
+def test_detector_admission_starvation():
+    det = detectors.ServeAnomalyDetector(starvation_windows=1)
+    before = detectors.SERVE_COUNTERS.serve_admission_starvation
+    out = det.check_window(queue_depth=3, admitted=0, refusals_delta=2,
+                           spec_proposed_delta=0, spec_accepted_delta=0,
+                           lru_reclaims_delta=0, prefix_hits_delta=0)
+    assert out == ["admission_starvation"]
+    assert detectors.SERVE_COUNTERS.serve_admission_starvation \
+        == before + 1
+    # progress resets the streak: admitted > 0 never flags
+    out = det.check_window(queue_depth=3, admitted=1, refusals_delta=2,
+                           spec_proposed_delta=0, spec_accepted_delta=0,
+                           lru_reclaims_delta=0, prefix_hits_delta=0)
+    assert out == []
+    # a 2-window threshold needs 2 consecutive starved windows
+    det2 = detectors.ServeAnomalyDetector(starvation_windows=2)
+    assert det2.check_window(
+        queue_depth=1, admitted=0, refusals_delta=1,
+        spec_proposed_delta=0, spec_accepted_delta=0,
+        lru_reclaims_delta=0, prefix_hits_delta=0) == []
+    assert det2.check_window(
+        queue_depth=1, admitted=0, refusals_delta=1,
+        spec_proposed_delta=0, spec_accepted_delta=0,
+        lru_reclaims_delta=0,
+        prefix_hits_delta=0) == ["admission_starvation"]
+
+
+def test_detector_accept_rate_collapse():
+    det = detectors.ServeAnomalyDetector(accept_floor=0.25,
+                                         min_spec_proposals=16)
+    ok = dict(queue_depth=0, admitted=1, refusals_delta=0,
+              lru_reclaims_delta=0, prefix_hits_delta=0)
+    # healthy accept rate: quiet
+    assert det.check_window(spec_proposed_delta=20,
+                            spec_accepted_delta=15, **ok) == []
+    # too few proposals to judge: quiet
+    assert det.check_window(spec_proposed_delta=4,
+                            spec_accepted_delta=0, **ok) == []
+    # collapse
+    assert det.check_window(
+        spec_proposed_delta=20, spec_accepted_delta=2,
+        **ok) == ["spec_accept_collapse"]
+    assert detectors.SERVE_COUNTERS.serve_accept_collapse == 1
+
+
+def test_detector_pool_thrash():
+    det = detectors.ServeAnomalyDetector(thrash_reclaims=8)
+    ok = dict(queue_depth=0, admitted=1, refusals_delta=0,
+              spec_proposed_delta=0, spec_accepted_delta=0)
+    # reclaims below the floor: quiet
+    assert det.check_window(lru_reclaims_delta=4, prefix_hits_delta=0,
+                            **ok) == []
+    # heavy reclaim but the cache still pays for itself: quiet
+    assert det.check_window(lru_reclaims_delta=10, prefix_hits_delta=12,
+                            **ok) == []
+    assert det.check_window(lru_reclaims_delta=10, prefix_hits_delta=1,
+                            **ok) == ["pool_thrash"]
+    assert detectors.SERVE_COUNTERS.serve_pool_thrash == 1
+
+
+# ------------------------------------------------------------ pool gauges
+
+def test_page_pool_gauges_shared_and_lru():
+    import jax.numpy as jnp
+    spec = kvcache.KVCacheSpec(layers=1, slots=2, capacity=32,
+                               kv_heads_local=1, head_dim=4,
+                               dtype=jnp.float32, page_tokens=8)
+    pool = kvcache.PagePool(spec)
+    # two full pages + one token: lookup leaves at least one token to
+    # forward, so both full pages are reusable
+    prompt = list(range(17))
+    g0 = pool.admit(0, prompt, 4)
+    pool.publish(g0)
+    g1 = pool.admit(1, prompt, 4)      # hits the published chain
+    assert g1.reused_pages == 2
+    g = pool.gauges()
+    assert g["shared_pages"] == 2      # refcount 2 on the shared pages
+    assert g["prefix_hits"] == 1
+    assert g["prefix_tokens_reused"] == 16
+    assert g["pages_in_use"] == g0.new_pages + g1.new_pages
+    pool.release(0)
+    pool.release(1)
+    g = pool.gauges()
+    assert g["pages_in_use"] == 0
+    assert g["lru_pages"] == 2         # published pages park on the LRU
+    assert g["free_pages"] == spec.num_pages
+    # reclaiming the LRU pages counts (the thrash signal)
+    while pool._free:
+        pool._free.pop()
+    assert pool._take_page() is not None
+    assert pool.gauges()["lru_reclaims"] == 1
+
+
+# ---------------------------------------------------------- config guards
+
+def test_config_guards():
+    with pytest.raises(DeepSpeedConfigError, match="unknown"):
+        InferenceEngine(tiny_model(),
+                        config=serve_config(obs={"bogus": 1}), seed=0)
+    for bad in ({"window_iters": 0}, {"watchdog_timeout_s": -1},
+                {"health_port": 99999}, {"accept_floor": 1.5},
+                {"thrash_reclaims": -2}, {"jsonl_path": 7}):
+        with pytest.raises(DeepSpeedConfigError):
+            InferenceEngine(tiny_model(), config=serve_config(obs=bad),
+                            seed=0)
