@@ -388,6 +388,9 @@ def predict_executables_serve(engine) -> ExecutablePrediction:
       model — ``draft_prefill`` + the fused ``spec_step`` (the J-draft +
       verify dispatch; the per-iteration ``decode`` then only compiles
       for the static baseline / custom-sampler fallback);
+    * with ``inference.fleet.disaggregate``, the KV handoff pair —
+      ``export_kv`` + ``import_kv`` (one shape-stable executable each,
+      regardless of prompt length or reuse offset).
     The ring-layout ``copy_page`` program is deliberately NOT counted:
     it compiles only if a wrap-around ever collides with a shared page —
     an exceptional path, priced by the dispatch plan's note instead of
@@ -403,6 +406,9 @@ def predict_executables_serve(engine) -> ExecutablePrediction:
         programs.append(("decode_many", "slots", 1))
     else:
         programs.append(("decode", "slots", 1))
+    if bool(getattr(engine, "fleet_disaggregate", False)):
+        programs.append(("export_kv", "capacity", 1))
+        programs.append(("import_kv", "capacity", 1))
     return ExecutablePrediction(subject="serve", programs=programs)
 
 

@@ -1352,6 +1352,88 @@ def load_params_only(load_dir: str, tag: Optional[str] = None, specs=None,
     return tag, treedef.unflatten(out)
 
 
+# --------------------------------------------------------- KV handoff
+# Prefill/decode disaggregation ships a slot's written KV page rows from
+# a prefill replica to a decode replica as ONE chunk-container file —
+# the same on-disk machinery as checkpoints (atomic tmp+rename seal,
+# positioned memmap reads, validated chunk refs), so the handoff
+# inherits every corruption/torn-file guarantee for free
+# (deepspeed_tpu/inference/router.py, docs/inference.md "Fleet serving").
+
+KV_HANDOFF_SCHEMA = "dstpu.kv_handoff"
+KV_HANDOFF_VERSION = 1
+
+
+def write_kv_handoff(path: str, *, k, v, meta: dict,
+                     io_retries: int = 3) -> str:
+    """Seal one slot's KV handoff artifact at ``path``: the written
+    ``k``/``v`` rows (``[layers, tokens, kv_heads, head_dim]``, the
+    GLOBAL heads dim) as payload chunks plus a ``meta`` bookkeeping dict
+    (prompt tokens, first token, dims — the importer validates these
+    against its own cache spec).  Atomic (tmp + rename) and retried
+    through ``io_retry`` like every checkpoint write; the target
+    directory is created if missing."""
+    from deepspeed_tpu.resilience.retry import io_retry
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    header = {"schema": KV_HANDOFF_SCHEMA, "version": KV_HANDOFF_VERSION,
+              "meta": dict(meta)}
+
+    def _write():
+        w = _ChunkedWriter(path)
+        try:
+            payload = dict(header)
+            payload["k"] = w.put_array(k)
+            payload["v"] = w.put_array(v)
+            w.finish(payload)
+        except BaseException:
+            w.abort()
+            raise
+    io_retry(_write, retries=io_retries,
+             what=f"kv handoff write {path!r}")
+    return path
+
+
+def read_kv_handoff(path: str, io_retries: int = 3):
+    """Load a KV handoff artifact: ``(meta, k, v)`` with the arrays
+    materialized from positioned memmap reads (the PR 5 reader's chunk
+    resolution — offsets/dtypes/shapes validated against the payload
+    region BEFORE any view is built).  Transient storage errors retry
+    through ``io_retry``; a corrupt, truncated or wrong-schema file
+    raises :class:`CheckpointReadError` naming the problem — a decode
+    replica must fail the one handoff loudly, never import garbage
+    pages."""
+    from deepspeed_tpu.resilience.retry import io_retry
+
+    def _read():
+        _chaos.read_point("ckpt_read")   # chaos tier: Nth-read IO failure
+        return _load_obj(path)
+
+    try:
+        obj = io_retry(_read, retries=io_retries,
+                       what=f"kv handoff read {path!r}")
+    except (ValueError, pickle.UnpicklingError, EOFError) as e:
+        raise CheckpointReadError(
+            f"corrupt KV handoff {path!r}: {e}") from e
+    if not isinstance(obj, dict) \
+            or obj.get("schema") != KV_HANDOFF_SCHEMA:
+        raise CheckpointReadError(
+            f"{path!r} is not a KV handoff artifact (schema "
+            f"{obj.get('schema') if isinstance(obj, dict) else None!r})")
+    if obj.get("version") != KV_HANDOFF_VERSION:
+        raise CheckpointReadError(
+            f"KV handoff {path!r} has version {obj.get('version')!r}, "
+            f"this reader understands {KV_HANDOFF_VERSION}")
+    try:
+        # np.asarray faults the memmap pages in NOW, so a payload
+        # truncated past the validated header surfaces here, named
+        k = np.asarray(obj["k"])
+        v = np.asarray(obj["v"])
+    except (KeyError, ValueError, OSError) as e:
+        raise CheckpointReadError(
+            f"corrupt KV handoff {path!r}: {e}") from e
+    return obj.get("meta", {}), k, v
+
+
 def _zero3_rehydrate(load_dir: str, tag: str, states, lazy: bool = False):
     """Replace stage-3 partition markers in freshly read model states with
     full-along-data leaves reassembled from the per-(row, dp) shard files

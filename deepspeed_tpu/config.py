@@ -685,7 +685,7 @@ class DeepSpeedConfig:
                      C.INFERENCE_DECODE_ITERS_PER_DISPATCH,
                      C.INFERENCE_PREFIX_REUSE, C.INFERENCE_POOL_PAGES,
                      C.INFERENCE_TAIL_BUCKET, C.INFERENCE_SPECULATIVE,
-                     C.INFERENCE_OBSERVABILITY}
+                     C.INFERENCE_OBSERVABILITY, C.INFERENCE_FLEET}
         if inf is not None and set(inf) - inf_known:
             # a typo'd serving knob would silently serve with defaults —
             # loud, like the resilience section
@@ -822,6 +822,110 @@ class DeepSpeedConfig:
                     f"the paged kv_layout: the multi-position verify "
                     f"step cannot wrap a ring window mid-block "
                     f"(docs/inference.md)")
+
+        # fleet serving: the router layer over N replicas + optional
+        # prefill/decode disaggregation (docs/inference.md "Fleet
+        # serving").  The ENGINE reads only `disaggregate` (it gates the
+        # KV export/import programs); the router reads the rest.
+        fleet = get_scalar_param(inf, C.INFERENCE_FLEET, None)
+        if fleet is not None and not isinstance(fleet, Mapping):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET} must be a JSON "
+                f"object, got {fleet!r}")
+        fleet_known = {C.INFERENCE_FLEET_REPLICAS,
+                       C.INFERENCE_FLEET_PREFILL_REPLICAS,
+                       C.INFERENCE_FLEET_DISAGGREGATE,
+                       C.INFERENCE_FLEET_HEALTH_PORT,
+                       C.INFERENCE_FLEET_POLL_S,
+                       C.INFERENCE_FLEET_AFFINITY,
+                       C.INFERENCE_FLEET_HANDOFF_DIR,
+                       C.INFERENCE_FLEET_JSONL_PATH}
+        if fleet is not None and set(fleet) - fleet_known:
+            raise DeepSpeedConfigError(
+                f"unknown {C.INFERENCE}.{C.INFERENCE_FLEET} key(s) "
+                f"{sorted(set(fleet) - fleet_known)}; supported: "
+                f"{sorted(fleet_known)}")
+        fleet = fleet or {}
+
+        def _fleet_num(key, default, cast):
+            val = fleet.get(key, default)
+            try:
+                return cast(val)
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_FLEET}.{key} must be a "
+                    f"number, got {val!r}")
+
+        self.inference_fleet_replicas = _fleet_num(
+            C.INFERENCE_FLEET_REPLICAS,
+            C.INFERENCE_FLEET_REPLICAS_DEFAULT, int)
+        if self.inference_fleet_replicas < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_REPLICAS} must be >= 0 (0 = no "
+                f"fleet)")
+        self.inference_fleet_prefill_replicas = _fleet_num(
+            C.INFERENCE_FLEET_PREFILL_REPLICAS,
+            C.INFERENCE_FLEET_PREFILL_REPLICAS_DEFAULT, int)
+        if self.inference_fleet_prefill_replicas < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_PREFILL_REPLICAS} must be >= 0 "
+                f"(0 = mixed pool)")
+        if self.inference_fleet_replicas \
+                and self.inference_fleet_prefill_replicas \
+                >= self.inference_fleet_replicas:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_PREFILL_REPLICAS} "
+                f"({self.inference_fleet_prefill_replicas}) must leave "
+                f"at least one DECODE replica (replicas = "
+                f"{self.inference_fleet_replicas})")
+        self.inference_fleet_disaggregate = bool(fleet.get(
+            C.INFERENCE_FLEET_DISAGGREGATE,
+            C.INFERENCE_FLEET_DISAGGREGATE_DEFAULT))
+        if self.inference_fleet_prefill_replicas > 0 \
+                and not self.inference_fleet_disaggregate:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_PREFILL_REPLICAS} > 0 needs "
+                f"{C.INFERENCE_FLEET_DISAGGREGATE}: true (the prefill "
+                f"pool hands KV off through the export/import programs)")
+        self.inference_fleet_health_port = _fleet_num(
+            C.INFERENCE_FLEET_HEALTH_PORT,
+            C.INFERENCE_FLEET_HEALTH_PORT_DEFAULT, int)
+        if not (0 <= self.inference_fleet_health_port <= 65535):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_HEALTH_PORT} must be in [0, 65535]")
+        self.inference_fleet_poll_s = _fleet_num(
+            C.INFERENCE_FLEET_POLL_S, C.INFERENCE_FLEET_POLL_S_DEFAULT,
+            float)
+        if self.inference_fleet_poll_s <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_POLL_S} must be > 0")
+        self.inference_fleet_affinity = bool(fleet.get(
+            C.INFERENCE_FLEET_AFFINITY,
+            C.INFERENCE_FLEET_AFFINITY_DEFAULT))
+        self.inference_fleet_handoff_dir = fleet.get(
+            C.INFERENCE_FLEET_HANDOFF_DIR,
+            C.INFERENCE_FLEET_HANDOFF_DIR_DEFAULT)
+        if self.inference_fleet_handoff_dir is not None \
+                and not isinstance(self.inference_fleet_handoff_dir, str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_HANDOFF_DIR} must be a directory "
+                f"string, got {self.inference_fleet_handoff_dir!r}")
+        self.inference_fleet_jsonl_path = fleet.get(
+            C.INFERENCE_FLEET_JSONL_PATH,
+            C.INFERENCE_FLEET_JSONL_PATH_DEFAULT)
+        if self.inference_fleet_jsonl_path is not None \
+                and not isinstance(self.inference_fleet_jsonl_path, str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FLEET}."
+                f"{C.INFERENCE_FLEET_JSONL_PATH} must be a path string, "
+                f"got {self.inference_fleet_jsonl_path!r}")
 
         # replica observability: request events, live endpoints, the
         # serve watchdog and anomaly detectors (docs/observability.md

@@ -402,6 +402,44 @@ INFERENCE_OBS_ACCEPT_FLOOR_DEFAULT = 0.25
 INFERENCE_OBS_THRASH_RECLAIMS = "thrash_reclaims"
 INFERENCE_OBS_THRASH_RECLAIMS_DEFAULT = 8
 
+# fleet serving (docs/inference.md "Fleet serving"): the router layer
+# over N InferenceEngine replicas — least-loaded admission off the
+# replica /metrics gauges, /healthz-503 eviction with resubmission, and
+# optional prefill/decode disaggregation with KV handoff
+# (deepspeed_tpu/inference/router.py)
+INFERENCE_FLEET = "fleet"
+# serving replicas the router drives (0 = no fleet; serve_gpt2.py
+# --fleet / FleetRouter(replicas=...) override)
+INFERENCE_FLEET_REPLICAS = "replicas"
+INFERENCE_FLEET_REPLICAS_DEFAULT = 0
+# of those, how many form the PREFILL pool (0 = mixed pool, no
+# disaggregation; > 0 requires disaggregate: true)
+INFERENCE_FLEET_PREFILL_REPLICAS = "prefill_replicas"
+INFERENCE_FLEET_PREFILL_REPLICAS_DEFAULT = 0
+# build + gate the KV export/import programs (the handoff path); the
+# engine refuses export_kv/import_kv without it so the exactly-N
+# executables promise stays a checked invariant
+INFERENCE_FLEET_DISAGGREGATE = "disaggregate"
+INFERENCE_FLEET_DISAGGREGATE_DEFAULT = False
+# > 0 serves the ROUTER's own /healthz /status /metrics here (replica
+# endpoints ride inference.observability.health_port + replica index)
+INFERENCE_FLEET_HEALTH_PORT = "health_port"
+INFERENCE_FLEET_HEALTH_PORT_DEFAULT = 0
+# router health/metrics poll + telemetry-window cadence (seconds)
+INFERENCE_FLEET_POLL_S = "poll_s"
+INFERENCE_FLEET_POLL_S_DEFAULT = 0.05
+# route requests to the replica whose page-hash index already holds
+# the prompt's page-aligned prefix (PR 13 reuse at fleet scale)
+INFERENCE_FLEET_AFFINITY = "affinity"
+INFERENCE_FLEET_AFFINITY_DEFAULT = True
+# KV handoff artifact directory (disaggregation; default: a tempdir)
+INFERENCE_FLEET_HANDOFF_DIR = "handoff_dir"
+INFERENCE_FLEET_HANDOFF_DIR_DEFAULT = None
+# router telemetry JSONL (dstpu.telemetry.router windows; the
+# FleetRouter jsonl_path argument beats it)
+INFERENCE_FLEET_JSONL_PATH = "jsonl_path"
+INFERENCE_FLEET_JSONL_PATH_DEFAULT = None
+
 #############################################
 # Checkpoint IO (TPU-native: background writer thread + parallel streaming
 # restore — checkpoint.py, docs/resilience.md "Time to resume".  No

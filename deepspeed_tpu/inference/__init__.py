@@ -18,6 +18,7 @@ bf16 or int8-weight-quantized compute.  See docs/inference.md.
 
 from deepspeed_tpu.inference import driver, kvcache, quant  # noqa: F401
 from deepspeed_tpu.inference import observability  # noqa: F401
+from deepspeed_tpu.inference import router  # noqa: F401
 from deepspeed_tpu.inference.driver import (ServeTelemetry,  # noqa: F401
                                             run_serve, synthetic_requests)
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
@@ -25,14 +26,18 @@ from deepspeed_tpu.inference.kvcache import (KVCacheSpec,  # noqa: F401
                                              PagePool)
 from deepspeed_tpu.inference.observability import (  # noqa: F401
     ServeObservability)
+from deepspeed_tpu.inference.router import (FleetRouter,  # noqa: F401
+                                            RouterObservability, run_fleet)
 from deepspeed_tpu.inference.scheduler import (  # noqa: F401
-    ContinuousScheduler, Request, RequestResult, StaticScheduler,
-    greedy_sampler, latency_summary, request_latency_ms)
+    ContinuousScheduler, KVHandoff, Request, RequestResult,
+    StaticScheduler, greedy_sampler, latency_summary, request_latency_ms)
 
 __all__ = [
     "InferenceEngine", "KVCacheSpec", "PagePool", "ContinuousScheduler",
-    "StaticScheduler", "Request", "RequestResult", "greedy_sampler",
-    "latency_summary", "request_latency_ms", "ServeTelemetry",
-    "ServeObservability", "run_serve", "synthetic_requests", "driver",
-    "kvcache", "observability", "quant",
+    "StaticScheduler", "Request", "RequestResult", "KVHandoff",
+    "greedy_sampler", "latency_summary", "request_latency_ms",
+    "ServeTelemetry", "ServeObservability", "FleetRouter",
+    "RouterObservability", "run_fleet", "run_serve",
+    "synthetic_requests", "driver", "kvcache", "observability", "quant",
+    "router",
 ]

@@ -280,6 +280,17 @@ class InferenceEngine:
         self._copy_page_fn = (self._build_copy_page()
                               if self.cache_spec.ring and self.prefix_reuse
                               else None)
+        # prefill/decode disaggregation (docs/inference.md "Fleet
+        # serving"): the KV handoff programs exist ONLY when the config
+        # declares the fleet disaggregated — they then ride the same
+        # build gates as every other program, and the exactly-N
+        # executables promise stays a checked number
+        self.fleet_disaggregate = bool(
+            self.config.inference_fleet_disaggregate)
+        self._export_kv_fn = (self._build_export_kv()
+                              if self.fleet_disaggregate else None)
+        self._import_kv_fn = (self._build_import_kv()
+                              if self.fleet_disaggregate else None)
         self._warned_fused_fallback = False
         # replica observability hooks (inference/observability.py): a
         # watchdog attached here arms around every dispatch; the decode
@@ -409,6 +420,10 @@ class InferenceEngine:
             return (1, 2, 3, 5, 6)      # k, v, pos, draft k, draft v
         if kind == "copy_page":
             return (0, 1)
+        if kind == "export_kv":
+            return ()                   # a pure read: the pool stays live
+        if kind == "import_kv":
+            return (0, 1, 4)            # k, v, pos
         return (1, 2, 3)                # k, v, pos
 
     # ------------------------------------------------------------ programs
@@ -665,6 +680,59 @@ class InferenceEngine:
         return jax.jit(fn,
                        donate_argnums=self._donate_argnums("copy_page"))
 
+    def _build_export_kv(self):
+        """KV handoff, device side of the EXPORT: gather one slot's
+        logical token rows out of the flat page pools —
+        ``rows`` int32 [capacity] (the slot's resolved row map) →
+        ``([L, capacity, heads/mp, d], …)`` k/v blocks.  A pure read
+        (nothing donated: the pool stays live under every other slot);
+        the host then reads the block — the handoff's ONE counted fence
+        — and ships rows ``[0, pos)`` through the checkpoint chunk
+        container (docs/inference.md "Fleet serving")."""
+        def local(k, v, rows):
+            return (jnp.take(k, rows, axis=1, mode="clip"),
+                    jnp.take(v, rows, axis=1, mode="clip"))
+
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._cache_specs["k"], self._cache_specs["v"],
+                      P()),
+            out_specs=(P(None, None, MODEL_AXIS, None),
+                       P(None, None, MODEL_AXIS, None)),
+            check_vma=False)
+        return jax.jit(fn,
+                       donate_argnums=self._donate_argnums("export_kv"))
+
+    def _build_import_kv(self):
+        """KV handoff, device side of the IMPORT: scatter a handed-off
+        ``[L, capacity, heads/mp, d]`` k/v block into this replica's own
+        pools at ``rows`` (drop-row entries — the un-written tail, and
+        any prefix the local index already shares — are dropped
+        in-program, so an import can NEVER touch a page another request
+        or the prefix cache owns) and pin ``pos[slot] = n_tokens``.
+        Shape-stable: one executable regardless of prompt length or
+        reuse offset, like every other serving program."""
+        n_slots = self.cache_spec.slots
+
+        def local(k, v, kb, vb, pos, rows, slot, n_tokens):
+            k = k.at[:, rows].set(kb.astype(k.dtype), mode="drop")
+            v = v.at[:, rows].set(vb.astype(v.dtype), mode="drop")
+            oh = (jnp.arange(n_slots, dtype=jnp.int32) == slot)
+            pos = jnp.where(oh, n_tokens, pos)
+            return k, v, pos
+
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._cache_specs["k"], self._cache_specs["v"],
+                      P(None, None, MODEL_AXIS, None),
+                      P(None, None, MODEL_AXIS, None),
+                      P(), P(), P(), P()),
+            out_specs=(self._cache_specs["k"], self._cache_specs["v"],
+                       P()),
+            check_vma=False)
+        return jax.jit(fn,
+                       donate_argnums=self._donate_argnums("import_kv"))
+
     def _program_args(self, kind: str):
         """Example argument tuples for tracing (lint + planner) — shapes
         only, no execution."""
@@ -701,6 +769,16 @@ class InferenceEngine:
                     svec(jnp.int32), i32)
         if kind == "copy_page":
             return (k, v, i32, i32)
+        if kind in ("export_kv", "import_kv"):
+            rows_cap = jax.ShapeDtypeStruct((cap,), jnp.int32)
+            if kind == "export_kv":
+                return (k, v, rows_cap)
+            heads_g = (self.cache_spec.kv_heads_local
+                       * self.cache_spec.mp_size)
+            block = jax.ShapeDtypeStruct(
+                (self.cache_spec.layers, cap, heads_g,
+                 self.cache_spec.head_dim), self.cache_spec.dtype)
+            return (k, v, block, block, pos, rows_cap, i32, i32)
         return (self.params, k, v, pos, svec(jnp.int32),
                 svec(jnp.bool_), rows_all)
 
@@ -721,6 +799,9 @@ class InferenceEngine:
             out.append(("spec_step", self._spec_fn))
         if self._copy_page_fn is not None:
             out.append(("copy_page", self._copy_page_fn))
+        if self._export_kv_fn is not None:
+            out.append(("export_kv", self._export_kv_fn))
+            out.append(("import_kv", self._import_kv_fn))
         return tuple(out)
 
     def run_graph_lint(self) -> graph_lint.Report:
@@ -1014,6 +1095,121 @@ class InferenceEngine:
                              pages=len(self.pool.slot_pages(int(slot))))
         self.pool.release(int(slot))
         self._host_pos[slot] = 0
+
+    def export_kv(self, slot: int):
+        """Read slot ``slot``'s written KV rows off the device for a
+        prefill→decode handoff: ``(k, v, n_tokens)`` with the arrays
+        ``[layers, n_tokens, kv_heads(global), head_dim]`` in the cache
+        dtype — exactly the bytes the extend program wrote, so a decode
+        replica importing them continues BYTE-IDENTICALLY (the PR 13
+        bitwise-page contract is what makes the handoff exact).  ONE
+        counted fence (the host read is the handoff's data dependency).
+        Requires ``inference.fleet.disaggregate`` — the programs are
+        gated at build like every other (docs/inference.md "Fleet
+        serving")."""
+        if self._export_kv_fn is None:
+            raise RuntimeError(
+                "export_kv needs inference.fleet.disaggregate: true "
+                "(the KV handoff programs were not built — "
+                "docs/inference.md \"Fleet serving\")")
+        n_tokens = int(self._host_pos[int(slot)])
+        if n_tokens < 1:
+            raise ValueError(
+                f"slot {slot} holds no written rows — prefill it before "
+                f"exporting")
+        rows = self.pool.slot_rows(int(slot))
+        _RECORDER.record("serve_export_kv", slot=int(slot),
+                         tokens=n_tokens)
+        with self._armed("serve_export_kv"), annotate("serve_export_kv"):
+            kb, vb = self._export_kv_fn(
+                self._cache["k"], self._cache["v"],
+                np.asarray(rows, np.int32))
+            out = obs_fences.read_arrays(kb, vb)
+        return (np.asarray(out[0])[:, :n_tokens],
+                np.asarray(out[1])[:, :n_tokens], n_tokens)
+
+    def import_kv(self, slot: int, prompt_tokens, k_rows, v_rows,
+                  max_new_tokens: int):
+        """Admit ``slot`` from a KV handoff instead of a prefill
+        dispatch: allocate the slot's page range (leading pages from the
+        local prefix index when the prompt's page-aligned prefix is
+        already resident — shared pages hold the SAME bytes the handoff
+        carries, so they are never re-written), scatter the handed-off
+        rows into the fresh pages, publish the full prompt pages, and
+        pin the slot's position.  Returns the
+        :class:`~deepspeed_tpu.inference.kvcache.AdmitGrant` (``None`` =
+        pool refusal, nothing allocated — the router keeps the handoff
+        queued).  Dimension/dtype mismatches against this replica's
+        cache spec raise before anything is touched."""
+        if self._import_kv_fn is None:
+            raise RuntimeError(
+                "import_kv needs inference.fleet.disaggregate: true "
+                "(the KV handoff programs were not built — "
+                "docs/inference.md \"Fleet serving\")")
+        toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        n_tokens = int(toks.size)
+        spec = self.cache_spec
+        heads_g = spec.kv_heads_local * spec.mp_size
+        expect = (spec.layers, n_tokens, heads_g, spec.head_dim)
+        k_rows = np.asarray(k_rows)
+        v_rows = np.asarray(v_rows)
+        if tuple(k_rows.shape) != expect or tuple(v_rows.shape) != expect:
+            raise ValueError(
+                f"KV handoff shape mismatch: k {tuple(k_rows.shape)} / "
+                f"v {tuple(v_rows.shape)}, this replica expects "
+                f"{expect} — prefill and decode pools must share "
+                f"(layers, kv_heads, head_dim) and the prompt length")
+        for name, arr in (("k", k_rows), ("v", v_rows)):
+            if np.dtype(arr.dtype) != np.dtype(spec.dtype):
+                raise ValueError(
+                    f"KV handoff {name} dtype {arr.dtype} != this "
+                    f"replica's cache dtype {np.dtype(spec.dtype)} — "
+                    f"byte identity needs identical cache dtypes "
+                    f"across the fleet (a silent cast here would "
+                    f"corrupt pages)")
+        if n_tokens > spec.capacity:
+            raise ValueError(
+                f"KV handoff of {n_tokens} tokens exceeds the per-slot "
+                f"capacity ({spec.capacity})")
+        self.release(slot)
+        grant = self.pool.admit(int(slot), toks.tolist(),
+                                int(max_new_tokens),
+                                reuse=self.prefix_reuse)
+        if grant is None:
+            _RECORDER.record("serve_refusal", slot=int(slot),
+                             prompt_tokens=n_tokens,
+                             free_pages=self.pool.free_pages)
+            return None
+        rows = np.asarray(self.pool.slot_rows(int(slot)), np.int32).copy()
+        drop = np.int32(spec.pool_rows)
+        # shared-prefix pages already hold the identical bytes: never
+        # write them (they may be concurrently attended by other slots);
+        # rows past the prompt stay unwritten until decode produces them
+        rows[:grant.reused_tokens] = drop
+        rows[n_tokens:] = drop
+        kb = np.zeros((spec.layers, spec.capacity, heads_g,
+                       spec.head_dim), np.dtype(spec.dtype))
+        vb = np.zeros_like(kb)
+        kb[:, :n_tokens] = k_rows
+        vb[:, :n_tokens] = v_rows
+        _RECORDER.record("serve_import_kv", slot=int(slot),
+                         tokens=n_tokens, reused=grant.reused_tokens)
+        t0 = time.perf_counter()
+        with self._armed("serve_import_kv"), annotate("serve_import_kv"):
+            k, v, pos = self._import_kv_fn(
+                self._cache["k"], self._cache["v"], kb, vb,
+                self._cache["pos"], rows, np.int32(slot),
+                np.int32(n_tokens))
+            self._cache = {"k": k, "v": v, "pos": pos}
+        if self.prefix_reuse:
+            self.pool.publish(grant)
+        self._host_pos[int(slot)] = n_tokens
+        if self.first_token_ts is None:
+            # a pure-decode replica "serves its first token" at the
+            # first import — the startup event needs the anchor
+            self.first_token_ts = time.time()
+            self.first_dispatch_s = time.perf_counter() - t0
+        return grant
 
     def prefill(self, slot: int, prompt_tokens) -> np.ndarray:
         """Prefill ``prompt_tokens`` into cache ``slot`` WITHOUT prefix

@@ -92,7 +92,7 @@ class ServeObservability:
     the engine's page pool, the scheduler the telemetry layer notes, and
     the last emitted window event."""
 
-    def __init__(self, engine, telemetry=None):
+    def __init__(self, engine, telemetry=None, port=None):
         import jax
 
         from deepspeed_tpu.observability import detectors
@@ -122,11 +122,12 @@ class ServeObservability:
             engine.attach_watchdog(self.watchdog)
             # a chaos stall armed via env ends when the watchdog reacted
             # (the CI chaos leg's contract: stall -> fire -> 503 -> the
-            # run completes and the outputs stay exact)
+            # run completes and the outputs stay exact); every replica
+            # in the process registers — the stall lands in whichever
+            # replica dispatches first, and only ITS watchdog fires
             from deepspeed_tpu.resilience import chaos
-            if chaos._state.stall_step is not None \
-                    and chaos._state.stall_until is None:
-                chaos.configure(stall_until=self.watchdog.fire_event)
+            if chaos._state.stall_step is not None:
+                chaos.add_stall_until(self.watchdog.fire_event)
 
         # serve anomaly detectors (window-delta checks, driver.py feeds
         # them at each flush)
@@ -139,9 +140,16 @@ class ServeObservability:
         # env fallback DSTPU_HEALTH_PORT — serve_gpt2.py --health_port /
         # dst --health_port export it; offset by process index like the
         # training endpoints)
+        # `port` overrides the config/env resolution — a fleet router
+        # hosting several replicas IN ONE process assigns each its own
+        # port explicitly (the rank offset cannot separate co-process
+        # replicas); 0 disables, None defers to config/env
         self.health = None
-        port = health_mod.resolve_health_port(
-            cfg.inference_obs_health_port)
+        if port is None:
+            port = health_mod.resolve_health_port(
+                cfg.inference_obs_health_port)
+        elif not port:
+            port = None
         if port is not None:
             try:
                 self.health = health_mod.HealthServer(
@@ -213,10 +221,16 @@ class ServeObservability:
         from deepspeed_tpu.resilience import COUNTERS
         with self._lock:
             sched = self._sched
+        from deepspeed_tpu.observability import health as health_mod
         out = {
             "healthy": 1 if self.healthy() else 0,
             "slots_total": self.engine.num_slots,
             "watchdog_fires": COUNTERS.watchdog_fires,
+            # restart detection (the router's replica-identity signals):
+            # uptime resets and the generation ordinal increments when
+            # the launcher relaunches a wedged/preempted replica
+            "process_uptime_s": round(health_mod.process_uptime_s(), 3),
+            "replica_generation": health_mod.replica_generation(),
         }
         for k, v in self.engine.pool.gauges().items():
             out[f"pool_{k}"] = v

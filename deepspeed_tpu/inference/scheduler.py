@@ -181,6 +181,26 @@ def _check_request(engine, req: Request) -> None:
             f"layout's sliding window (docs/inference.md)")
 
 
+@dataclasses.dataclass
+class KVHandoff:
+    """A prefilled request in flight between pools: the prefill
+    replica's output (first token + the slot's written KV rows) plus
+    the lifecycle timestamps the decode replica must PRESERVE — TTFT
+    was measured when the prefill produced the first token, and queue
+    wait keeps anchoring at the user's original submit
+    (docs/inference.md "Fleet serving")."""
+    req: Request
+    prompt: List[int]            # the full prompt (page hashing + admit)
+    first_token: int             # sampled from the prefill's logits row
+    k: "np.ndarray"              # [L, n_tokens, kv_heads(global), d]
+    v: "np.ndarray"
+    n_tokens: int                # rows written (== len(prompt))
+    t_enqueue: float             # the user's ORIGINAL submit time
+    t_admit: float               # prefill admission dispatch start
+    t_first_token: float         # first-token sample time (TTFT anchor)
+    path: Optional[str] = None   # sealed artifact file (router cleanup)
+
+
 class _Slot:
     """Host-side mirror of one decode slot."""
 
@@ -227,6 +247,7 @@ class ContinuousScheduler:
                                           # called with each RequestResult
                                           # at eviction (request events)
         self.queue: List[tuple] = []      # (request, t_enqueue)
+        self.handoffs: List[KVHandoff] = []   # prefilled, awaiting import
         self.slots: List[Optional[_Slot]] = [None] * engine.num_slots
         self.results: List[RequestResult] = []
         self.decode_iters = 0
@@ -241,9 +262,55 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------- intake
     def submit(self, request: Request, now: Optional[float] = None):
+        """Queue a request.  ``now`` overrides the enqueue timestamp —
+        a fleet router resubmitting a request displaced by replica
+        death passes the ORIGINAL arrival time, so queue-wait/TTFT
+        percentiles keep measuring from the user's submit instead of
+        silently resetting (the :meth:`evacuate` contract)."""
         _check_request(self.engine, request)
         self.queue.append((request, time.perf_counter()
                            if now is None else now))
+
+    def submit_handoff(self, handoff: KVHandoff) -> None:
+        """Queue a PREFILLED request (KV handed off from a prefill
+        replica): admission imports the rows into a free slot instead
+        of dispatching prefill — the decode pool's intake
+        (docs/inference.md "Fleet serving").  The request must fit this
+        engine's budgets exactly like a fresh submit."""
+        _check_request(self.engine, handoff.req)
+        self.handoffs.append(handoff)
+
+    def _admit_handoffs(self) -> int:
+        """Import queued handoffs into free slots; returns tokens landed
+        (each handoff arrives WITH its first token).  A pool refusal
+        keeps the remaining handoffs queued — transient, like the
+        regular admission path."""
+        admitted_tokens = 0
+        for i in range(len(self.slots)):
+            if not self.handoffs or self.slots[i] is not None:
+                continue
+            h = self.handoffs[0]
+            grant = self.engine.import_kv(
+                i, h.prompt, h.k, h.v, h.req.max_new_tokens)
+            if grant is None:
+                self.admission_refusals += 1
+                break            # pool exhausted: no later slot differs
+            self.handoffs.pop(0)
+            if grant.reused_tokens:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += grant.reused_tokens
+            # lifecycle bookkeeping PRESERVES the prefill-side times:
+            # TTFT anchored at the prefill's first-token sample, queue
+            # wait at the user's original submit
+            self.slots[i] = _Slot(
+                h.req, h.first_token, h.t_enqueue, h.t_first_token,
+                t_admit=h.t_admit, reused_tokens=grant.reused_tokens,
+                pages_mapped=len(self.engine.pool.slot_pages(i)))
+            self.admitted += 1
+            admitted_tokens += 1
+            if _stops(h.req, h.first_token, 1):
+                self._evict(i)
+        return admitted_tokens
 
     @property
     def active(self) -> int:
@@ -251,13 +318,48 @@ class ContinuousScheduler:
 
     @property
     def pending(self) -> int:
-        return len(self.queue)
+        return len(self.queue) + len(self.handoffs)
+
+    def evacuate(self) -> List[tuple]:
+        """Pull every in-flight AND queued request back out as
+        ``(request, t_enqueue)`` pairs, releasing their engine slots —
+        the replica-eviction path (docs/inference.md "Fleet serving").
+
+        The pairs carry each request's ORIGINAL arrival timestamp: a
+        request displaced by replica death must re-enter the surviving
+        replica's queue via ``submit(req, now=t_enqueue)``, so its queue
+        wait and TTFT keep accruing from the user's submit.  Resubmitting
+        with a fresh timestamp would silently reset TTFT at the exact
+        moment the fleet is slowest — the tail percentiles would lie.
+        Partial generations are discarded: greedy decode re-derives the
+        identical token stream from the prompt (the exactness contract),
+        so nothing is lost but the wasted iterations.
+
+        In-flight requests come first (they arrived before anything
+        still queued), each pool page they held is released, and the
+        scheduler is left empty and reusable."""
+        pairs = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            self.slots[i] = None
+            self.engine.release(i)
+            pairs.append((s.req, s.t_enqueue))
+        # un-imported handoffs re-enter as plain requests (the surviving
+        # replica re-prefills; greedy identity makes that loss-free)
+        pairs.extend((h.req, h.t_enqueue) for h in self.handoffs)
+        self.handoffs = []
+        pairs.extend(self.queue)
+        self.queue = []
+        return pairs
 
     # ------------------------------------------------------------ stepping
     def step(self) -> dict:
         """One scheduler iteration; returns the iteration's stats."""
         eng = self.engine
-        admitted_now = 0
+        # 0) handed-off prefills land first: they arrived before
+        # anything still queued and their KV is already paid for
+        admitted_now = self._admit_handoffs() if self.handoffs else 0
         # 1) admission: fill free slots from the queue (every queued
         # request already passed the submit-time budget checks).  A
         # prefix-cache hit maps the prompt's page-aligned prefix to
@@ -406,7 +508,7 @@ class ContinuousScheduler:
             "admitted": admitted_now,
             "tokens_out": tokens_out,
             "active": len(active_idx),
-            "queue_depth": len(self.queue),
+            "queue_depth": len(self.queue) + len(self.handoffs),
         }
 
     def _evict(self, slot_idx: int):
@@ -437,7 +539,7 @@ class ContinuousScheduler:
         for r in (requests or []):
             self.submit(r)
         it = 0
-        while self.queue or self.active:
+        while self.queue or self.handoffs or self.active:
             stats = self.step()
             if self.on_event is not None:
                 self.on_event(self, stats)

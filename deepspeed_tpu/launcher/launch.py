@@ -34,7 +34,8 @@ import sys
 import time
 
 from deepspeed_tpu.launcher.run import decode_world_info
-from deepspeed_tpu.observability.health import ENV_HEALTH_PORT
+from deepspeed_tpu.observability.health import (ENV_HEALTH_PORT,
+                                                ENV_REPLICA_GENERATION)
 from deepspeed_tpu.observability.tracing import ENV_TRACE_DIR
 from deepspeed_tpu.resilience import RESTARTABLE_EXIT_CODES
 from deepspeed_tpu.utils.compile_cache import ENV_DIR as COMPILE_CACHE_ENV_DIR
@@ -105,10 +106,14 @@ def global_rank_mapping(world_info):
     return mapping
 
 
-def _spawn_procs(args, local_ranks, world_size, node_host):
+def _spawn_procs(args, local_ranks, world_size, node_host, generation=0):
     procs = []
     for local_rank, global_rank in enumerate(local_ranks):
         env = os.environ.copy()
+        # restart ordinal for the /metrics replica_generation gauge: a
+        # fleet router tells a RELAUNCHED worker (generation bumped,
+        # uptime reset) from a live one (observability/health.py)
+        env[ENV_REPLICA_GENERATION] = str(int(generation))
         env["DSTPU_COORDINATOR"] = f"{args.master_addr}:{args.master_port}"
         env["DSTPU_NUM_PROCESSES"] = str(world_size)
         env["DSTPU_PROCESS_ID"] = str(global_rank)
@@ -154,7 +159,8 @@ def main(args=None):
 
     attempt = 0
     while True:
-        procs = _spawn_procs(args, local_ranks, world_size, node_host)
+        procs = _spawn_procs(args, local_ranks, world_size, node_host,
+                             generation=attempt)
         rc = 0
         for p in procs:
             p.wait()

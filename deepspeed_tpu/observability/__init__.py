@@ -550,6 +550,11 @@ class Telemetry:
         if engine is not None:
             out["step"] = int(engine.global_steps)
         out["healthy"] = 1 if self.healthy() else 0
+        # restart detection for the fleet router: uptime resets and the
+        # generation ordinal increments on a --max_restarts relaunch
+        from deepspeed_tpu.observability import health as _health
+        out["process_uptime_s"] = round(_health.process_uptime_s(), 3)
+        out["replica_generation"] = _health.replica_generation()
         with self._lock:
             last_window = self.last_window_event
             last_fleet = self.last_fleet_event

@@ -1,12 +1,14 @@
 """JSONL event-log validator CLI.
 
 ``python -m deepspeed_tpu.observability <events.jsonl> [...]`` — validates
-every line of each telemetry event log.  Streams may interleave the five
+every line of each telemetry event log.  Streams may interleave the six
 event schemas (``dstpu.telemetry.window`` v1/v2, ``dstpu.telemetry.fleet``
 v2, ``dstpu.telemetry.startup`` v2, ``dstpu.telemetry.serve`` v1/v2/v3,
-``dstpu.telemetry.request`` v1 — observability/schema.py, each on its own
-version track); v1 window-only logs from before the fleet layer still
-validate, as do PR 10/13 serve logs without the later columns.  The
+``dstpu.telemetry.request`` v1, ``dstpu.telemetry.router`` v1 —
+observability/schema.py, each on its own version track); v1 window-only
+logs from before the fleet layer still validate, as do PR 10/13 serve
+logs without the later columns.  A fleet-serve run's one stream holds
+router windows next to each replica's serve/request events.  The
 per-file summary is version-aware (``3 serve v3, 8 request v1, …``).
 Exit codes:
 0 = every file valid and non-empty, 2 = any problem — invalid lines,
@@ -28,7 +30,8 @@ def _summary(path: str) -> str:
     short = {schema.SCHEMA_ID: "window", schema.FLEET_SCHEMA_ID: "fleet",
              schema.STARTUP_SCHEMA_ID: "startup",
              schema.SERVE_SCHEMA_ID: "serve",
-             schema.REQUEST_SCHEMA_ID: "request"}
+             schema.REQUEST_SCHEMA_ID: "request",
+             schema.ROUTER_SCHEMA_ID: "router"}
     parts = [f"{n} {short.get(sid, sid)}"
              + (f" v{version}" if version is not None else "")
              for (sid, version), n in sorted(counts.items(),
@@ -40,10 +43,10 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability",
         description="Validate telemetry JSONL event logs (schemas: "
-                    "%s v1/v2, %s v2, %s v2, %s v1/v2/v3, %s v1)" % (
-                        schema.SCHEMA_ID, schema.FLEET_SCHEMA_ID,
-                        schema.STARTUP_SCHEMA_ID, schema.SERVE_SCHEMA_ID,
-                        schema.REQUEST_SCHEMA_ID))
+                    "%s v1/v2, %s v2, %s v2, %s v1/v2/v3, %s v1, %s v1)"
+                    % (schema.SCHEMA_ID, schema.FLEET_SCHEMA_ID,
+                       schema.STARTUP_SCHEMA_ID, schema.SERVE_SCHEMA_ID,
+                       schema.REQUEST_SCHEMA_ID, schema.ROUTER_SCHEMA_ID))
     parser.add_argument("paths", nargs="+", help="JSONL event log(s)")
     args = parser.parse_args(argv)
 

@@ -40,7 +40,40 @@ logger = logging.getLogger(__name__)
 #: (config ``observability.health_port`` beats it)
 ENV_HEALTH_PORT = "DSTPU_HEALTH_PORT"
 
+#: env spelling of the replica generation: the launcher's restart loop
+#: exports the attempt ordinal on every relaunch, so a restarted worker
+#: is distinguishable from a live one by a MONOTONIC counter instead of
+#: a guessed uptime comparison (the fleet router's restart detector —
+#: docs/inference.md "Fleet serving")
+ENV_REPLICA_GENERATION = "DSTPU_REPLICA_GENERATION"
+
+#: interpreter start (module import is early enough for the uptime
+#: gauge's purpose: a restarted replica's uptime visibly resets)
+_PROCESS_START_TS = time.time()
+
 _METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def process_uptime_s() -> float:
+    """Wall seconds this process has been alive — the ``/metrics``
+    ``dstpu_process_uptime_s`` gauge.  A router comparing two scrapes of
+    the same endpoint can tell "same replica, later" from "the replica
+    restarted between scrapes" (uptime went DOWN)."""
+    return time.time() - _PROCESS_START_TS
+
+
+def replica_generation() -> int:
+    """Monotonic restart ordinal for this worker: 0 on first launch,
+    incremented by the launcher on every ``--max_restarts`` relaunch
+    (:data:`ENV_REPLICA_GENERATION`).  The unambiguous restart signal —
+    uptime alone cannot distinguish a fast restart from a scrape gap."""
+    v = os.environ.get(ENV_REPLICA_GENERATION, "").strip()
+    try:
+        return int(v) if v else 0
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r",
+                       ENV_REPLICA_GENERATION, v)
+        return 0
 
 
 def resolve_health_port(cfg_port) -> Optional[int]:

@@ -7,7 +7,7 @@ guessing.  Validation is hand-rolled — no jsonschema dependency — and
 doubles as the documentation of record for every field
 (docs/observability.md mirrors these tables).
 
-Five event schemas share one stream (a rank-0 log interleaves them):
+Six event schemas share one stream (a rank-0 log interleaves them):
 
 * ``dstpu.telemetry.window``  — one line per drained metric window.
   v1 (PR 7) logs still validate; v2 adds the per-host fleet-report
@@ -34,6 +34,12 @@ Five event schemas share one stream (a rank-0 log interleaves them):
   time-to-first-token, per-token decode latency, prefix-reuse facts
   (pages mapped / tokens served from shared pages) and the finish
   reason (docs/observability.md "Serving view").
+* ``dstpu.telemetry.router`` — one line per fleet-router window (v1):
+  fleet-wide tokens/s, the per-replica load map (the /metrics gauges
+  the router routed on), evictions/resubmits, prefill→decode KV
+  handoffs and prefix-affinity hits
+  (deepspeed_tpu/inference/router.py, docs/inference.md "Fleet
+  serving").
 
 Schema evolution contract: additive fields bump the version with
 validators accepting all :data:`ACCEPTED_VERSIONS` and unknown EXTRA
@@ -70,6 +76,13 @@ SERVE_ACCEPTED_VERSIONS = (1, 2, 3)
 #: per-request lifecycle records (one line per COMPLETED request)
 REQUEST_SCHEMA_ID = "dstpu.telemetry.request"
 REQUEST_SCHEMA_VERSION = 1
+
+#: fleet-router windows (PR 15, deepspeed_tpu/inference/router.py): one
+#: line per router reporting window — the fleet-level roll-up the
+#: per-replica serve events cannot see (evictions, resubmits, handoffs,
+#: the admission-time load map)
+ROUTER_SCHEMA_ID = "dstpu.telemetry.router"
+ROUTER_SCHEMA_VERSION = 1
 
 _NUM = numbers.Real
 
@@ -232,6 +245,39 @@ REQUEST_FIELDS = {
     "pages_mapped": (int, True),        # page-table entries this request
 }
 
+#: router event fields (schema ``dstpu.telemetry.router`` v1) — the
+#: fleet window record.  Cumulative counters are over the router's
+#: lifetime (like the serve schema's ``evicted``); rates are this
+#: window's.
+ROUTER_FIELDS = {
+    "schema": (str, True),
+    "version": (int, True),
+    "ts": (_NUM, True),
+    "window": (int, True),              # window ordinal (1-based)
+    "n_replicas": (int, True),          # replicas the router knows
+    "healthy_replicas": (int, True),    # answering 200 at this window
+    "prefill_replicas": (int, True),    # disaggregated prefill pool (0 =
+                                        # no disaggregation)
+    "requests_submitted": (int, True),  # cumulative intake
+    "requests_completed": (int, True),  # cumulative completions
+    "requests_inflight": (int, True),   # handed to a replica, not done
+    "queue_depth": (int, True),         # waiting at the ROUTER (no
+                                        # replica chosen yet)
+    "tokens_out": (int, True),          # cumulative fleet tokens
+    "tokens_per_sec": (_NUM, False),    # this window's fleet rate
+    "evictions": (int, True),           # replicas evicted (503/wedge)
+    "resubmits": (int, True),           # requests re-queued by eviction
+    "handoffs": (int, True),            # prefill→decode KV handoffs
+    "affinity_hits": (int, True),       # admissions routed to the
+                                        # replica holding the prefix
+    "ttft_p50_ms": (_NUM, False),       # over completed requests so far
+    "ttft_p99_ms": (_NUM, False),
+    "queue_wait_p50_ms": (_NUM, False),
+    "queue_wait_p99_ms": (_NUM, False),
+    "per_replica": (dict, True),        # replica id(str) -> load map
+                                        # (the /metrics gauges routed on)
+}
+
 _SCHEMAS = None
 
 
@@ -244,6 +290,7 @@ def _schemas():
             STARTUP_SCHEMA_ID: (STARTUP_FIELDS, (2,)),
             SERVE_SCHEMA_ID: (SERVE_FIELDS, SERVE_ACCEPTED_VERSIONS),
             REQUEST_SCHEMA_ID: (REQUEST_FIELDS, (1,)),
+            ROUTER_SCHEMA_ID: (ROUTER_FIELDS, (1,)),
         }
     return _SCHEMAS
 
@@ -382,6 +429,37 @@ def validate_request_event(event: dict) -> Optional[str]:
     return None
 
 
+def validate_router_event(event: dict) -> Optional[str]:
+    """Validate a fleet-router window event."""
+    if not isinstance(event, dict):
+        return f"event is {type(event).__name__}, expected object"
+    if event.get("schema") != ROUTER_SCHEMA_ID:
+        return (f"schema is {event.get('schema')!r}, expected "
+                f"{ROUTER_SCHEMA_ID!r}")
+    msg = _validate_fields(event, ROUTER_FIELDS, (1,))
+    if msg is not None:
+        return msg
+    if event["n_replicas"] < 1:
+        return f"n_replicas must be >= 1, got {event['n_replicas']}"
+    if not (0 <= event["healthy_replicas"] <= event["n_replicas"]):
+        return (f"healthy_replicas ({event['healthy_replicas']}) outside "
+                f"[0, n_replicas={event['n_replicas']}]")
+    if not (0 <= event["prefill_replicas"] <= event["n_replicas"]):
+        return (f"prefill_replicas ({event['prefill_replicas']}) outside "
+                f"[0, n_replicas={event['n_replicas']}]")
+    if event["requests_completed"] > event["requests_submitted"]:
+        return (f"requests_completed ({event['requests_completed']}) "
+                f"exceeds requests_submitted "
+                f"({event['requests_submitted']})")
+    for name in ("requests_inflight", "queue_depth", "tokens_out",
+                 "evictions", "resubmits", "handoffs", "affinity_hits"):
+        if event[name] < 0:
+            return f"{name} must be >= 0, got {event[name]}"
+    if not isinstance(event["per_replica"], dict):
+        return "per_replica must be an object"
+    return None
+
+
 def _validate_counters(counters: dict) -> Optional[str]:
     for k, v in counters.items():
         if not isinstance(k, str) or (v is not None
@@ -392,9 +470,9 @@ def _validate_counters(counters: dict) -> Optional[str]:
 
 def validate_any(event: dict) -> Optional[str]:
     """Dispatch on the event's ``schema`` field: window (v1/v2), fleet,
-    startup, serve (v1/v2/v3) and request events all validate; anything
-    else is invalid — a stream of unknown schemas must fail the gate,
-    not slide through."""
+    startup, serve (v1/v2/v3), request and router events all validate;
+    anything else is invalid — a stream of unknown schemas must fail the
+    gate, not slide through."""
     if not isinstance(event, dict):
         return f"event is {type(event).__name__}, expected object"
     sid = event.get("schema")
@@ -408,9 +486,12 @@ def validate_any(event: dict) -> Optional[str]:
         return validate_serve_event(event)
     if sid == REQUEST_SCHEMA_ID:
         return validate_request_event(event)
+    if sid == ROUTER_SCHEMA_ID:
+        return validate_router_event(event)
     return (f"unknown schema {sid!r}; expected one of "
             f"[{SCHEMA_ID!r}, {FLEET_SCHEMA_ID!r}, {STARTUP_SCHEMA_ID!r}, "
-            f"{SERVE_SCHEMA_ID!r}, {REQUEST_SCHEMA_ID!r}]")
+            f"{SERVE_SCHEMA_ID!r}, {REQUEST_SCHEMA_ID!r}, "
+            f"{ROUTER_SCHEMA_ID!r}]")
 
 
 def validate_jsonl(path: str) -> list:

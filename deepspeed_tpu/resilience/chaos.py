@@ -182,6 +182,33 @@ def maybe_stall(step: int) -> None:
         chaos_stall(_state.stall_s, until=until)
 
 
+class _AnyEvent:
+    """Composite stall-ender for multi-replica processes: only the
+    replica that actually stalls has a watchdog that will fire, so the
+    stall ends when ANY registered event sets."""
+
+    def __init__(self, events):
+        self.events = list(events)
+
+    def is_set(self) -> bool:
+        return any(e.is_set() for e in self.events)
+
+
+def add_stall_until(event) -> None:
+    """Register an ADDITIONAL stall-ending event.  ``configure``
+    replaces the event; a process hosting several replicas (each with
+    its own watchdog) must instead accumulate them — the stall lands in
+    whichever replica reaches the armed dispatch first, and only that
+    replica's watchdog reacts."""
+    cur = _state.stall_until
+    if cur is None:
+        _state.stall_until = event
+    elif isinstance(cur, _AnyEvent):
+        cur.events.append(event)
+    else:
+        _state.stall_until = _AnyEvent([cur, event])
+
+
 def chaos_stall(seconds: float, until=None) -> None:
     """Burn wall-clock inside a frame named ``chaos_stall`` so a watchdog
     stack dump identifies the stuck site by name.  ``until`` (a

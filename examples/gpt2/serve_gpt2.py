@@ -29,6 +29,19 @@ the serve watchdog; ``--chaos-stall-iter N`` stalls the Nth decode
 dispatch and gates the watchdog-fire → 503 → loadable-dump chain;
 ``--verify-identity`` re-serves the trace observability-off and
 requires bitwise-identical outputs + fence counts.
+
+Fleet serving (docs/inference.md "Fleet serving"): ``--fleet N`` loads
+the SAME checkpoint into N replicas behind the least-loaded router
+(``--prefill-replicas K`` splits K of them into a prefill pool with KV
+handoff — the config needs ``inference.fleet.disaggregate``);
+``--router-port`` serves the ROUTER's own endpoints, per-replica
+endpoints ride ``--health_port`` + replica index, and
+``--probe-endpoints`` probes the router AND every replica mid-traffic.
+``--verify-identity`` then re-serves the trace on ONE replica and
+requires identical greedy outputs — placement must be
+output-invisible; with ``--chaos-stall-iter`` the wedged replica's
+eviction + resubmission must also be invisible (exit 1 unless at least
+one eviction happened AND outputs still match).
 """
 
 import os as _os
@@ -225,6 +238,144 @@ def serve(args):
     return rc
 
 
+def serve_fleet(args):
+    """--fleet N: the same checkpoint behind the least-loaded router
+    (docs/inference.md "Fleet serving") — N in-process replicas, each
+    its own engine + scheduler + driver thread + live endpoints;
+    ``--prefill-replicas K`` disaggregates K of them into a prefill
+    pool with chunk-container KV handoff."""
+    from deepspeed_tpu.inference import (FleetRouter, InferenceEngine,
+                                         synthetic_requests)
+    from deepspeed_tpu.models import GPT2
+
+    cfg = _load_config(args)
+    fleet_cfg = cfg.get("inference", {}).get("fleet", {})
+    n = args.fleet or int(fleet_cfg.get("replicas", 0)) or 2
+    k = (args.prefill_replicas if args.prefill_replicas is not None
+         else int(fleet_cfg.get("prefill_replicas", 0)))
+    if k < 0 or k >= n:
+        # the config spelling gets this guard in config.py; the CLI
+        # values never pass through it
+        print(f"ERROR: --prefill-replicas {k} must leave at least one "
+              f"DECODE replica out of --fleet {n}", file=_sys.stderr)
+        return 1
+    if args.chaos_stall_iter:
+        from deepspeed_tpu.resilience import chaos
+        chaos.configure(stall_step=args.chaos_stall_iter,
+                        stall_s=args.chaos_stall_s)
+
+    def build():
+        model = GPT2.from_size(args.size, vocab_size=VOCAB,
+                               max_seq_len=SEQ)
+        return InferenceEngine(model, config=cfg,
+                               checkpoint_dir=args.ckpt)
+
+    decode = [build() for _ in range(n - k)]
+    prefill = [build() for _ in range(k)]
+    print(f"fleet: {n - k} decode/mixed + {k} prefill replica(s), "
+          f"tag {decode[0].loaded_tag}")
+    reqs = synthetic_requests(
+        args.requests, vocab=VOCAB, seed=1, prompt_min=4,
+        prompt_max=min(16, decode[0].prefill_bucket),
+        new_min=4, new_max=args.max_new)
+
+    router = FleetRouter(decode, prefill, jsonl_path=args.jsonl,
+                         health_port=args.router_port,
+                         window_iters=args.window)
+    probers = []
+    router_prober = None
+    if args.probe_endpoints:
+        replica_ports = [rep.port for rep in router.all_replicas
+                         if rep.port is not None]
+        router_port = (router.obs.port if router.obs is not None
+                       else None)
+        if router_port is None and not replica_ports:
+            print("ERROR: --probe-endpoints needs --router-port and/or "
+                  "--health_port", file=_sys.stderr)
+            return 1
+        if router_port is not None:
+            router_prober = _EndpointProber(router_port)
+            probers.append(router_prober)
+        probers.extend(_EndpointProber(p) for p in replica_ports)
+        for p in probers:
+            p.start()
+    try:
+        out = router.serve(reqs)
+    finally:
+        for p in probers:
+            p.stop.set()
+        for p in probers:
+            p.join(timeout=5)
+    summary = out["summary"]
+
+    rc = 0
+    for p in probers:
+        if not p.healthz_codes:
+            print(f"ERROR: no successful probe of {p.base} "
+                  f"(errors: {p.errors[:3]})", file=_sys.stderr)
+            rc = 1
+    if probers and rc == 0:
+        if router_prober is not None:
+            router_metrics = router_prober.best_metrics or {}
+            if not (router_metrics.get("dstpu_n_replicas") or 0) >= n:
+                print(f"ERROR: router /metrics n_replicas gauge not "
+                      f"live: {router_metrics.get('dstpu_n_replicas')}",
+                      file=_sys.stderr)
+                rc = 1
+        if rc == 0:
+            n_rep_probers = len(probers) - (router_prober is not None)
+            print(f"endpoints: "
+                  + ("router + " if router_prober is not None else "")
+                  + f"{n_rep_probers} replica "
+                  f"endpoint(s) probed mid-traffic, "
+                  f"{sum(len(p.healthz_codes) for p in probers)} probes")
+    if args.chaos_stall_iter and summary["evictions"] < 1:
+        print("ERROR: chaos stall evicted no replica — the watchdog → "
+              "503 → eviction chain did not engage", file=_sys.stderr)
+        rc = 1
+    if k and summary["handoffs"] < 1:
+        print("ERROR: disaggregated fleet recorded no KV handoffs",
+              file=_sys.stderr)
+        rc = 1
+
+    empty = [r.rid for r in out["results"] if not r.tokens]
+    for r in sorted(out["results"], key=lambda r: r.rid)[:4]:
+        print(f"  req {r.rid}: prompt[{r.prompt_len}] -> "
+              f"{r.tokens} ({r.finish_reason})")
+    print(json.dumps(summary))
+    if empty:
+        print(f"ERROR: requests {empty} generated no tokens",
+              file=_sys.stderr)
+        rc = 1
+    router.close()
+
+    if args.verify_identity and rc == 0:
+        from deepspeed_tpu.inference import run_serve
+        from deepspeed_tpu.resilience import chaos
+        chaos.reset()                    # the single run must not stall
+        single = build()
+        base = run_serve(single, [r.__class__(
+            rid=r.rid, prompt=list(r.prompt),
+            max_new_tokens=r.max_new_tokens, eos_id=r.eos_id)
+            for r in reqs])
+        fleet_tokens = {r.rid: r.tokens for r in out["results"]}
+        base_tokens = {r.rid: r.tokens for r in base["results"]}
+        if fleet_tokens != base_tokens:
+            diff = [rid for rid in fleet_tokens
+                    if fleet_tokens[rid] != base_tokens.get(rid)]
+            print(f"ERROR: fleet placement changed greedy outputs for "
+                  f"requests {diff}", file=_sys.stderr)
+            return 1
+        print(f"identity: {len(base_tokens)} requests identical to a "
+              f"single replica"
+              + (f" (through {summary['evictions']} eviction(s) + "
+                 f"{summary['resubmits']} resubmit(s))"
+                 if summary["evictions"] else "")
+              + (f" ({summary['handoffs']} KV handoffs)"
+                 if summary["handoffs"] else ""))
+    return rc
+
+
 def _check_probes(args, prober) -> int:
     """Gate the mid-traffic endpoint probes: /healthz answered 200,
     /metrics parsed (parse_prometheus_text already gated every probe)
@@ -388,15 +539,30 @@ def main():
                         help="stall duration ceiling (ends early when "
                              "the watchdog reacted)")
     parser.add_argument("--verify-identity", action="store_true",
-                        help="re-serve the trace observability-off and "
-                             "require bitwise-identical outputs + fence "
-                             "count")
+                        help="re-serve the trace observability-off (or, "
+                             "with --fleet, on one replica) and require "
+                             "bitwise-identical outputs")
+    parser.add_argument("--fleet", type=int, default=0,
+                        help="serve through a least-loaded router over "
+                             "N in-process replicas (0 = single "
+                             "replica; falls back to the config's "
+                             "inference.fleet.replicas)")
+    parser.add_argument("--prefill-replicas", type=int, default=None,
+                        help="of the fleet, how many form the prefill "
+                             "pool (KV handoff to the decode pool; "
+                             "needs inference.fleet.disaggregate)")
+    parser.add_argument("--router-port", type=int, default=None,
+                        help="serve the ROUTER's own /healthz /status "
+                             "/metrics here (replica endpoints ride "
+                             "--health_port + replica index)")
     args = parser.parse_args()
     VOCAB, SEQ = args.vocab, args.seq
 
     if args.prepare:
         prepare(args)
         return 0
+    if args.fleet or args.prefill_replicas:
+        return serve_fleet(args)
     return serve(args)
 
 

@@ -1,0 +1,695 @@
+"""Serving fleet: least-loaded router + prefill/decode disaggregation
+(deepspeed_tpu/inference/router.py, docs/inference.md "Fleet serving").
+
+The load-bearing pins:
+
+* **Placement invisibility** — a 2-replica fleet produces greedy token
+  streams IDENTICAL to one replica on the same trace (batching
+  invariance is what makes the router's admission decisions
+  output-invisible), including THROUGH a replica eviction + resubmit.
+* **KV handoff byte identity** — a prefill replica's exported page rows
+  imported into a decode replica continue the request byte-identically
+  (the PR 13 bitwise-page contract: same weights + same tokens ⇒ same
+  page bytes), in memory and through the sealed chunk-container
+  artifact with its named corruption errors.
+* **Honest percentiles** — a request displaced by replica death
+  re-enters the queue with its ORIGINAL arrival timestamp
+  (``ContinuousScheduler.evacuate``/``submit(now=...)``), so
+  queue-wait/TTFT keep measuring from the user's submit instead of
+  silently resetting at the exact moment the fleet is slowest.
+* **Restart detection** — ``/metrics`` on BOTH training and serving
+  HealthServers exposes ``process_uptime_s`` and the launcher-fed
+  monotonic ``replica_generation``, the router's restarted-vs-live
+  replica signals.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import checkpoint
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import (ContinuousScheduler, FleetRouter,
+                                     InferenceEngine, KVHandoff, Request,
+                                     run_fleet, run_serve,
+                                     synthetic_requests)
+from deepspeed_tpu.models.gpt2 import GPT2
+from deepspeed_tpu.observability import flightrec, schema
+from deepspeed_tpu.observability import health as health_mod
+from deepspeed_tpu.resilience import chaos
+
+TINY = dict(vocab_size=128, max_seq_len=64, num_layers=2, hidden_size=64,
+            num_heads=4)
+
+
+def tiny_model():
+    return GPT2.from_size("tiny", **TINY)
+
+
+def serve_config(fleet=None, obs=None, **inf):
+    base = {"max_slots": 4, "max_tokens": 64, "prefill_bucket": 32,
+            "page_tokens": 8, "dtype": "float32"}
+    base.update(inf)
+    if fleet is not None:
+        base["fleet"] = fleet
+    if obs is not None:
+        base["observability"] = obs
+    return {"train_micro_batch_size_per_gpu": 1, "inference": base}
+
+
+def build_engine(fleet=None, obs=None, **inf):
+    return InferenceEngine(tiny_model(),
+                           config=serve_config(fleet=fleet, obs=obs, **inf),
+                           seed=0)
+
+
+def trace(n=10, seed=0):
+    return synthetic_requests(n, vocab=TINY["vocab_size"], seed=seed,
+                              prompt_min=2, prompt_max=8, new_min=4,
+                              new_max=14)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def single_reference():
+    """One replica's greedy token streams on the shared trace — the
+    identity oracle every fleet arrangement must reproduce."""
+    reqs = trace()
+    eng = build_engine()
+    res = run_serve(eng, reqs)["results"]
+    return reqs, {r.rid: r.tokens for r in res}
+
+
+# ---------------------------------------------------------------- fleet
+def test_fleet_identity_vs_single(single_reference):
+    reqs, ref = single_reference
+    out = run_fleet([build_engine(), build_engine()], reqs, poll_s=0.02)
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    s = out["summary"]
+    assert s["n_replicas"] == 2 and s["prefill_replicas"] == 0
+    assert s["evictions"] == 0 and s["resubmits"] == 0
+    assert s["requests"] == len(reqs)
+
+
+def test_router_requires_an_engine():
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter([])
+
+
+def test_router_rejects_over_budget_request_at_submit():
+    """Budget checks run at ROUTER intake: an over-budget request must
+    be the submitter's loud error — handed to a driver thread it would
+    kill the replica, be resubmitted by the eviction path, and serially
+    wedge the whole fleet."""
+    router = FleetRouter([build_engine()], poll_s=0.05)
+    try:
+        with pytest.raises(ValueError):
+            router.submit(Request(rid=1, prompt=list(range(200)),
+                                  max_new_tokens=4))
+        assert router.submitted == 0
+    finally:
+        router.close()
+
+
+def test_completion_from_evicted_replica_is_dropped(single_reference):
+    """The zombie guard: a wedged replica that un-sticks AFTER eviction
+    reports into the void — only the CURRENT owner's completion
+    lands (a resubmitted request must not double-complete)."""
+    from deepspeed_tpu.inference.router import _Flight
+    from deepspeed_tpu.inference.scheduler import RequestResult
+    router = FleetRouter([build_engine(), build_engine()], poll_s=0.05)
+    rep0, rep1 = router.replicas
+    req = Request(rid=7, prompt=[1, 2, 3], max_new_tokens=4)
+    router._inflight[7] = _Flight(req, 0.0, rep1, "mixed")
+
+    def result():
+        return RequestResult(rid=7, tokens=[1, 2], finish_reason="length",
+                             ttft_s=0.1, itl_s=[], prompt_len=3)
+
+    router._complete(rep0, result())          # zombie: not the owner
+    assert not router.results and 7 in router._inflight
+    router._complete(rep1, result())          # the owner lands
+    assert len(router.results) == 1 and 7 not in router._inflight
+    router.close()
+
+
+def test_prefix_affinity_routes_to_the_holding_replica():
+    """Shared-prefix requests all land on the replica whose page-hash
+    index holds the prefix — PR 13 reuse keeps paying at fleet scale
+    instead of being diluted 1/N by load-balancing."""
+    rng = np.random.default_rng(5)
+    sys_prompt = rng.integers(0, TINY["vocab_size"], size=24).astype(
+        int).tolist()          # 3 pages at page_tokens=8
+    reqs = []
+    for i in range(8):
+        tail = rng.integers(0, TINY["vocab_size"], size=int(
+            rng.integers(2, 6))).astype(int).tolist()
+        reqs.append(Request(rid=i, prompt=sys_prompt + tail,
+                            max_new_tokens=6))
+    engines = [build_engine(), build_engine()]
+    ref_eng = build_engine()
+    ref = {r.rid: r.tokens for r in run_serve(ref_eng, [
+        Request(rid=r.rid, prompt=list(r.prompt),
+                max_new_tokens=r.max_new_tokens) for r in reqs])["results"]}
+    out = run_fleet(engines, reqs, poll_s=0.02)
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    assert out["summary"]["affinity_hits"] > 0
+    # the fleet-level reuse proof: pages were actually served from the
+    # shared-prefix cache on the replica affinity kept routing to
+    assert sum(e.pool.tokens_reused for e in engines) > 0
+
+
+def test_affinity_off_records_no_hits(single_reference):
+    reqs, ref = single_reference
+    out = run_fleet([build_engine(), build_engine()], reqs,
+                    poll_s=0.02, affinity=False)
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    assert out["summary"]["affinity_hits"] == 0
+
+
+# ---------------------------------------------------- requeue semantics
+def test_evacuate_preserves_original_timestamps():
+    """Satellite fix: a request evicted by replica death re-enters the
+    queue with its ORIGINAL arrival timestamp — TTFT/queue-wait keep
+    anchoring at the user's submit, never silently resetting."""
+    eng = build_engine()
+    sched = ContinuousScheduler(eng)
+    t_orig = time.perf_counter() - 5.0      # submitted "5 seconds ago"
+    reqs = trace(6, seed=2)
+    for r in reqs[:3]:
+        sched.submit(r, now=t_orig)
+    sched.step()                            # admit some into slots
+    for r in reqs[3:]:
+        sched.submit(r, now=t_orig)         # still queued
+    assert sched.active > 0
+    pairs = sched.evacuate()
+    assert len(pairs) == 6
+    assert all(t == t_orig for _, t in pairs)
+    # in-flight first (they arrived before anything still queued)
+    assert [r.rid for r, _ in pairs[:3]] == [r.rid for r in reqs[:3]]
+    # the scheduler is left empty and reusable; pool pages released
+    assert sched.active == 0 and sched.pending == 0
+    assert eng.pool.gauges()["pages_in_use"] == 0
+    # resubmission through submit(now=...) keeps measuring from t_orig
+    eng2 = build_engine()
+    sched2 = ContinuousScheduler(eng2)
+    for r, t in pairs:
+        sched2.submit(r, now=t)
+    results = sched2.run()
+    assert all(r.queue_wait_s >= 5.0 for r in results), \
+        "queue wait must keep accruing from the ORIGINAL submit"
+    assert all(r.ttft_s >= 5.0 for r in results)
+
+
+def test_evacuate_returns_unimported_handoffs_as_requests():
+    eng = build_engine(fleet={"disaggregate": True})
+    sched = ContinuousScheduler(eng)
+    spec = eng.cache_spec
+    heads_g = spec.kv_heads_local * spec.mp_size
+    k = np.zeros((spec.layers, 3, heads_g, spec.head_dim), np.float32)
+    h = KVHandoff(req=Request(rid=1, prompt=[1, 2, 3], max_new_tokens=4),
+                  prompt=[1, 2, 3], first_token=5, k=k, v=k.copy(),
+                  n_tokens=3, t_enqueue=123.0, t_admit=124.0,
+                  t_first_token=125.0)
+    sched.submit_handoff(h)
+    assert sched.pending == 1
+    pairs = sched.evacuate()
+    assert pairs == [(h.req, 123.0)]
+
+
+# ------------------------------------------------------------- eviction
+@pytest.mark.chaos
+def test_eviction_chaos_end_to_end(single_reference, tmp_path):
+    """Satellite: --chaos-stall style wedge mid-traffic → serve watchdog
+    fires → /healthz 503 → router evicts + resubmits → every request
+    completes with outputs identical to an unwedged run — and the wedged
+    replica's flight-recorder dump loads and names the stalled decode
+    dispatch."""
+    reqs, ref = single_reference
+    dump_dir = str(tmp_path / "dumps")
+
+    def build_wd():
+        return build_engine(obs={"watchdog_timeout_s": 0.4,
+                                 "flight_recorder_dir": dump_dir})
+
+    engines = [build_wd(), build_wd()]
+    for e in engines:
+        e.generate([reqs[0].prompt], max_new_tokens=2)
+        e.reset()
+    stall_at = max(e.decode_dispatches for e in engines) + 3
+    chaos.configure(stall_step=stall_at, stall_s=30.0)
+    try:
+        out = run_fleet(engines, reqs, poll_s=0.02)
+    finally:
+        chaos.reset()
+    assert {r.rid: r.tokens for r in out["results"]} == ref, \
+        "greedy identity must survive eviction + resubmission"
+    s = out["summary"]
+    assert s["evictions"] >= 1 and s["resubmits"] >= 1
+    # exactly one watchdog fired (one replica wedged), its dump loads
+    # and the armed-region breadcrumb names the stalled decode
+    dumps = [f for f in os.listdir(dump_dir) if "watchdog" in f]
+    assert len(dumps) == 1, dumps
+    d = flightrec.load_dump(os.path.join(dump_dir, dumps[0]))
+    assert d["reason"] == "watchdog"
+    kinds = {e["kind"] for e in d["entries"]}
+    assert "serve_decode" in kinds, \
+        f"the dump must name the stalled decode dispatch, got {kinds}"
+
+
+def test_all_replicas_dead_is_an_error_not_a_hang(single_reference):
+    reqs, _ = single_reference
+    router = FleetRouter([build_engine()], poll_s=0.02)
+    router.start()
+    router.replicas[0].error = RuntimeError("driver died")
+    with pytest.raises(RuntimeError, match="no progress"):
+        router.serve(reqs[:2], timeout_s=30.0, stall_timeout_s=1.0)
+    router.close()
+
+
+# ----------------------------------------------------------- KV handoff
+def test_export_import_continues_byte_identically(single_reference):
+    """The disaggregation primitive in isolation: prefill on replica A,
+    export the slot's KV rows, import into replica B, decode there —
+    token stream identical to the single-replica run of the same
+    request (the bitwise-page contract doing the heavy lifting)."""
+    reqs, ref = single_reference
+    req = max(reqs, key=lambda r: r.max_new_tokens)
+    pre = build_engine(fleet={"disaggregate": True})
+    dec = build_engine(fleet={"disaggregate": True})
+    logits, reused = pre.admit(0, req.prompt, req.max_new_tokens)
+    tok0 = int(np.argmax(np.asarray(logits, np.float32)))
+    k, v, n = pre.export_kv(0)
+    assert n == len(req.prompt)
+    grant = dec.import_kv(0, req.prompt, k, v, req.max_new_tokens)
+    assert grant is not None
+    toks = [tok0]
+    feed = np.zeros((dec.num_slots,), np.int32)
+    active = np.zeros((dec.num_slots,), bool)
+    while len(toks) < req.max_new_tokens:
+        feed[0], active[0] = toks[-1], True
+        step_logits = dec.decode(feed, active)
+        toks.append(int(np.argmax(
+            np.asarray(step_logits[0], np.float32))))
+    assert toks == ref[req.rid]
+
+
+def test_kv_handoff_artifact_roundtrip(tmp_path):
+    path = str(tmp_path / "h.kvh")
+    k = np.arange(2 * 3 * 4 * 5, dtype=np.float32).reshape(2, 3, 4, 5)
+    v = -k
+    meta = {"rid": 3, "prompt": [1, 2, 3], "max_new_tokens": 7,
+            "eos_id": None, "first_token": 9, "n_tokens": 3,
+            "t_enqueue": 1.0, "t_admit": 2.0, "t_first_token": 3.0}
+    checkpoint.write_kv_handoff(path, k=k, v=v, meta=meta)
+    meta2, k2, v2 = checkpoint.read_kv_handoff(path)
+    assert meta2 == meta
+    np.testing.assert_array_equal(k2, k)
+    np.testing.assert_array_equal(v2, v)
+
+
+def test_kv_handoff_corruption_raises_named_errors(tmp_path):
+    path = str(tmp_path / "h.kvh")
+    k = np.ones((1, 2, 2, 2), np.float32)
+    checkpoint.write_kv_handoff(path, k=k, v=k, meta={"n_tokens": 2})
+    # truncated payload: the memmap fault surfaces as a NAMED error
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[:len(data) // 2])
+    with pytest.raises(checkpoint.CheckpointReadError):
+        checkpoint.read_kv_handoff(path)
+    # wrong artifact kind: schema is checked before any array view
+    import pickle
+    with open(path, "wb") as f:
+        pickle.dump({"schema": "not.a.handoff"}, f)
+    with pytest.raises(checkpoint.CheckpointReadError, match="schema"):
+        checkpoint.read_kv_handoff(path)
+
+
+def test_export_import_require_disaggregate_config():
+    eng = build_engine()
+    with pytest.raises(RuntimeError, match="disaggregate"):
+        eng.export_kv(0)
+    with pytest.raises(RuntimeError, match="disaggregate"):
+        eng.import_kv(0, [1, 2], np.zeros((2, 2, 4, 16), np.float32),
+                      np.zeros((2, 2, 4, 16), np.float32), 4)
+
+
+def test_import_kv_validates_shape_and_dtype():
+    eng = build_engine(fleet={"disaggregate": True})
+    spec = eng.cache_spec
+    heads_g = spec.kv_heads_local * spec.mp_size
+    good = np.zeros((spec.layers, 3, heads_g, spec.head_dim), np.float32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        eng.import_kv(0, [1, 2, 3], good[:, :2], good, 4)
+    with pytest.raises(ValueError, match="dtype"):
+        eng.import_kv(0, [1, 2, 3], good.astype(np.float16),
+                      good.astype(np.float16), 4)
+    # v alone diverging must raise too — a silent numpy cast here would
+    # corrupt value pages with no signal
+    with pytest.raises(ValueError, match="v dtype"):
+        eng.import_kv(0, [1, 2, 3], good, good.astype(np.float64), 4)
+    with pytest.raises(ValueError, match="capacity"):
+        toks = list(range(spec.capacity + 1))
+        big = np.zeros((spec.layers, spec.capacity + 1, heads_g,
+                        spec.head_dim), np.float32)
+        eng.import_kv(0, toks, big, big, 4)
+
+
+def test_corrupt_handoff_fails_one_request_not_the_replica(
+        single_reference, monkeypatch):
+    """A torn handoff artifact returns the ONE affected request to the
+    router for a fresh prefill — the decode replica stays healthy, no
+    eviction, and the re-derived outputs are identical (the documented
+    'fails one request loudly' contract)."""
+    reqs, ref = single_reference
+    from deepspeed_tpu import checkpoint as ckpt_mod
+    real = ckpt_mod.write_kv_handoff
+    corrupted = []
+
+    def corrupting(path, **kw):
+        real(path, **kw)
+        if not corrupted:               # torn file: first artifact only
+            corrupted.append(path)
+            data = open(path, "rb").read()
+            with open(path, "wb") as f:
+                f.write(data[:len(data) // 2])
+        return path
+
+    monkeypatch.setattr(ckpt_mod, "write_kv_handoff", corrupting)
+    dec = build_engine(fleet={"disaggregate": True})
+    pre = build_engine(fleet={"disaggregate": True})
+    out = run_fleet([dec], reqs, prefill_engines=[pre], poll_s=0.02)
+    assert corrupted, "the corruption injection never ran"
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    assert out["summary"]["evictions"] == 0
+    # the displaced request re-prefilled: one extra handoff
+    assert out["summary"]["handoffs"] == len(reqs) + 1
+
+
+def test_disaggregated_fleet_identity_and_handoffs(single_reference):
+    reqs, ref = single_reference
+    dec = build_engine(fleet={"disaggregate": True})
+    pre = build_engine(fleet={"disaggregate": True})
+    out = run_fleet([dec], reqs, prefill_engines=[pre], poll_s=0.02)
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    assert out["summary"]["handoffs"] == len(reqs)
+    assert out["summary"]["prefill_replicas"] == 1
+
+
+def test_prefill_pool_death_degrades_to_mixed(single_reference):
+    """Losing the WHOLE prefill pool must degrade the fleet to mixed
+    serving (decode replicas are full engines and can prefill), not
+    stall intake until the stall timeout fires."""
+    reqs, ref = single_reference
+    dec = build_engine(fleet={"disaggregate": True})
+    pre = build_engine(fleet={"disaggregate": True})
+    router = FleetRouter([dec], [pre], poll_s=0.02)
+    try:
+        router.start()
+        router._evict(router.prefill_pool[0])
+        out = router.serve(reqs, stall_timeout_s=30.0)
+        assert {r.rid: r.tokens for r in out["results"]} == ref
+        assert out["summary"]["handoffs"] == 0
+    finally:
+        router.close()
+
+
+def test_fleet_without_shared_sink_honors_replica_jsonl(
+        single_reference, tmp_path):
+    """With no fleet-level JSONL, a replica's own configured
+    observability stream must still be produced — the config knob
+    cannot be silently ignored in fleet mode."""
+    reqs, ref = single_reference
+    path = str(tmp_path / "replica.jsonl")
+    eng = build_engine(obs={"jsonl_path": path, "window_iters": 4})
+    out = run_fleet([eng], reqs, poll_s=0.02)
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    events = [json.loads(l) for l in open(path)]
+    assert any(e["schema"] == schema.SERVE_SCHEMA_ID for e in events)
+    assert sum(e["schema"] == schema.REQUEST_SCHEMA_ID
+               for e in events) == len(reqs)
+
+
+def test_disaggregation_is_greedy_only():
+    dec = build_engine(fleet={"disaggregate": True})
+    pre = build_engine(fleet={"disaggregate": True})
+    with pytest.raises(ValueError, match="greedy-only"):
+        FleetRouter([dec], [pre], sampler=lambda logits: 0)
+
+
+def test_disaggregation_requires_the_config_flag():
+    with pytest.raises(ValueError, match="disaggregate"):
+        FleetRouter([build_engine()], [build_engine()])
+
+
+def test_disaggregation_requires_matching_cache_specs():
+    """Handoff compatibility is a BUILD error: an ``import_kv``
+    shape/dtype mismatch fires inside the decode replica's driver
+    thread, where it reads as a wedge — the router would evict the
+    replica, resubmit its neighbours, and a minimal 1+1 topology
+    deadlocks into the stall timeout instead of naming the
+    misconfiguration."""
+    dec = build_engine(fleet={"disaggregate": True})
+    pre = build_engine(fleet={"disaggregate": True}, dtype="bfloat16")
+    with pytest.raises(ValueError, match="KV specs diverge"):
+        FleetRouter([dec], [pre])
+
+
+def test_router_removes_only_its_own_handoff_dir(tmp_path):
+    """A router-created (mkdtemp) handoff dir is removed at close; a
+    caller-provided dir is not the router's to remove."""
+    router = FleetRouter([build_engine()], poll_s=0.05)
+    own = router.handoff_dir
+    router.close()
+    assert not os.path.exists(own)
+    given = str(tmp_path / "handoffs")
+    router = FleetRouter([build_engine()], poll_s=0.05, handoff_dir=given)
+    router.close()
+    assert os.path.isdir(given)
+
+
+def test_chaos_stall_ends_when_any_registered_watchdog_fires():
+    """Multi-replica processes register EVERY replica's watchdog
+    fire_event (chaos.add_stall_until): the stall lands in whichever
+    replica dispatches first, and only that replica's watchdog reacts —
+    a single registered event from another replica would burn the full
+    stall_s."""
+    ev_first, ev_stalled = threading.Event(), threading.Event()
+    chaos.configure(stall_step=1, stall_s=30.0)
+    chaos.add_stall_until(ev_first)      # replica 0: never fires
+    chaos.add_stall_until(ev_stalled)    # replica 1: the stalled one
+    ev_stalled.set()
+    t0 = time.monotonic()
+    chaos.maybe_stall(1)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_predicted_executables_include_handoff_programs():
+    from deepspeed_tpu.analysis import stability
+    plain = stability.predict_executables_serve(build_engine())
+    dis = stability.predict_executables_serve(
+        build_engine(fleet={"disaggregate": True}))
+    names = {p[0] for p in dis.programs}
+    assert {"export_kv", "import_kv"} <= names
+    assert len(dis.programs) == len(plain.programs) + 2
+
+
+# ------------------------------------------------------------ telemetry
+def test_router_jsonl_validates_and_counts(single_reference, tmp_path):
+    reqs, ref = single_reference
+    path = str(tmp_path / "router.jsonl")
+    out = run_fleet([build_engine(), build_engine()], reqs,
+                    poll_s=0.02, jsonl_path=path)
+    assert {r.rid: r.tokens for r in out["results"]} == ref
+    problems = schema.validate_jsonl(path)
+    assert not problems, problems[:3]
+    events = [json.loads(l) for l in open(path)]
+    router_evs = [e for e in events
+                  if e["schema"] == schema.ROUTER_SCHEMA_ID]
+    assert router_evs, "no router windows on the stream"
+    last = router_evs[-1]
+    assert last["requests_completed"] == len(reqs)
+    assert last["n_replicas"] == 2
+    assert set(last["per_replica"]) == {"0", "1"}
+    for load in last["per_replica"].values():
+        assert {"slots_in_use", "queue_depth", "free_pages",
+                "healthy", "role"} <= set(load)
+    # replica request events interleave on the SAME stream
+    req_evs = [e for e in events
+               if e["schema"] == schema.REQUEST_SCHEMA_ID]
+    assert len(req_evs) == len(reqs)
+
+
+def test_router_event_schema_negatives():
+    base = {"schema": schema.ROUTER_SCHEMA_ID, "version": 1,
+            "ts": 1.0, "window": 1, "n_replicas": 2,
+            "healthy_replicas": 2, "prefill_replicas": 0,
+            "requests_submitted": 4, "requests_completed": 2,
+            "requests_inflight": 1, "queue_depth": 1, "tokens_out": 10,
+            "tokens_per_sec": 5.0, "evictions": 0, "resubmits": 0,
+            "handoffs": 0, "affinity_hits": 0, "ttft_p50_ms": 1.0,
+            "ttft_p99_ms": 2.0, "queue_wait_p50_ms": 0.1,
+            "queue_wait_p99_ms": 0.2, "per_replica": {}}
+    assert schema.validate_router_event(base) is None
+    assert schema.validate_any(base) is None
+    bad = dict(base, healthy_replicas=3)
+    assert "healthy_replicas" in schema.validate_router_event(bad)
+    bad = dict(base, requests_completed=9)
+    assert "requests_submitted" in schema.validate_router_event(bad)
+    bad = dict(base)
+    del bad["evictions"]
+    assert schema.validate_router_event(bad) is not None
+    bad = dict(base, resubmits=-1)
+    assert "resubmits" in schema.validate_router_event(bad)
+
+
+def test_validator_cli_handles_router_stream(tmp_path):
+    import subprocess
+    import sys
+    ev = {"schema": schema.ROUTER_SCHEMA_ID, "version": 1, "ts": 1.0,
+          "window": 1, "n_replicas": 1, "healthy_replicas": 1,
+          "prefill_replicas": 0, "requests_submitted": 1,
+          "requests_completed": 1, "requests_inflight": 0,
+          "queue_depth": 0, "tokens_out": 4, "tokens_per_sec": None,
+          "evictions": 0, "resubmits": 0, "handoffs": 0,
+          "affinity_hits": 0, "ttft_p50_ms": None, "ttft_p99_ms": None,
+          "queue_wait_p50_ms": None, "queue_wait_p99_ms": None,
+          "per_replica": {"0": {"slots_in_use": 0}}}
+    good = tmp_path / "router.jsonl"
+    good.write_text(json.dumps(ev) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.observability", str(good)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "router" in proc.stdout
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(dict(ev, n_replicas=0)) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.observability", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+# ------------------------------------------------------- live endpoints
+def test_router_and_replica_endpoints(single_reference):
+    """The fleet's own /healthz /status /metrics next to each replica's
+    per-replica endpoints — the cross-host router protocol served over
+    real HTTP from one process."""
+    reqs, ref = single_reference
+    router = FleetRouter([build_engine(), build_engine()],
+                         health_port=18985,
+                         replica_ports=[18986, 18987], poll_s=0.02)
+    try:
+        assert router.obs is not None and router.obs.port
+        ports = [rep.port for rep in router.replicas]
+        assert ports == [18986, 18987]
+        out = router.serve(reqs)
+        assert {r.rid: r.tokens for r in out["results"]} == ref
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.obs.port}/healthz",
+                timeout=5) as r:
+            assert r.getcode() == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.obs.port}/status",
+                timeout=5) as r:
+            status = json.loads(r.read())
+        assert status["n_replicas"] == 2
+        assert status["requests_completed"] == len(reqs)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.obs.port}/metrics",
+                timeout=5) as r:
+            parsed = health_mod.parse_prometheus_text(r.read().decode())
+        assert parsed["dstpu_healthy"] == 1
+        assert parsed["dstpu_healthy_replicas"] == 2
+        assert parsed["dstpu_tokens_out"] > 0
+        assert parsed["dstpu_process_uptime_s"] > 0
+        # each replica's own endpoint answers too (the router scrapes
+        # these for admission)
+        for port in ports:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                rep_metrics = health_mod.parse_prometheus_text(
+                    r.read().decode())
+            assert "dstpu_slots_in_use" in rep_metrics
+            assert "dstpu_process_uptime_s" in rep_metrics
+            assert "dstpu_replica_generation" in rep_metrics
+    finally:
+        router.close()
+
+
+def test_uptime_and_generation_gauges():
+    """Satellite: the restart-detection gauges on BOTH HealthServer
+    facades — uptime resets and the launcher-fed generation ordinal
+    increments on a relaunch."""
+    assert health_mod.process_uptime_s() > 0
+    # serving facade
+    from deepspeed_tpu.inference.observability import ServeObservability
+    obs = ServeObservability(build_engine(), port=0)
+    m = obs.health_metrics()
+    assert m["process_uptime_s"] > 0 and m["replica_generation"] == 0
+    obs.close()
+    # training facade (the Telemetry health_metrics the training
+    # HealthServer renders) — a minimal stand-in carrying exactly the
+    # state health_metrics reads
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.observability import Telemetry
+    tel = Telemetry.__new__(Telemetry)
+    tel._lock = threading.Lock()
+    tel._engine_ref = lambda: None
+    tel.registry = SimpleNamespace(counters_snapshot=lambda: {})
+    tel.healthy = lambda: True
+    tel.last_window_event = tel.last_fleet_event = None
+    m = tel.health_metrics()
+    assert m["process_uptime_s"] > 0 and m["replica_generation"] == 0
+
+
+def test_replica_generation_env(monkeypatch):
+    monkeypatch.setenv(health_mod.ENV_REPLICA_GENERATION, "3")
+    assert health_mod.replica_generation() == 3
+    monkeypatch.setenv(health_mod.ENV_REPLICA_GENERATION, "garbage")
+    assert health_mod.replica_generation() == 0
+    monkeypatch.delenv(health_mod.ENV_REPLICA_GENERATION)
+    assert health_mod.replica_generation() == 0
+
+
+# --------------------------------------------------------- config guards
+def test_fleet_config_guards():
+    from deepspeed_tpu.config import DeepSpeedConfig
+
+    def cfg(fleet):
+        return DeepSpeedConfig(serve_config(fleet=fleet))
+
+    ok = cfg({"replicas": 2, "prefill_replicas": 1, "disaggregate": True,
+              "health_port": 9000, "poll_s": 0.1, "affinity": False,
+              "handoff_dir": "/tmp/h", "jsonl_path": "/tmp/r.jsonl"})
+    assert ok.inference_fleet_replicas == 2
+    assert ok.inference_fleet_prefill_replicas == 1
+    assert ok.inference_fleet_disaggregate is True
+    assert ok.inference_fleet_affinity is False
+    with pytest.raises(DeepSpeedConfigError, match="unknown"):
+        cfg({"replica": 2})
+    with pytest.raises(DeepSpeedConfigError, match="disaggregate"):
+        cfg({"replicas": 2, "prefill_replicas": 1})
+    with pytest.raises(DeepSpeedConfigError, match="DECODE"):
+        cfg({"replicas": 2, "prefill_replicas": 2, "disaggregate": True})
+    with pytest.raises(DeepSpeedConfigError, match="poll_s"):
+        cfg({"poll_s": 0})
+    with pytest.raises(DeepSpeedConfigError, match="65535"):
+        cfg({"health_port": 70000})
+    with pytest.raises(DeepSpeedConfigError, match=">= 0"):
+        cfg({"replicas": -1})
+    with pytest.raises(DeepSpeedConfigError, match="object"):
+        cfg(17)
