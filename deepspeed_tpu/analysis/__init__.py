@@ -31,11 +31,14 @@ import jax
 
 from deepspeed_tpu.analysis import graph  # noqa: F401  (re-export for users)
 from deepspeed_tpu.analysis import commplan  # noqa: F401
+from deepspeed_tpu.analysis import concurrency  # noqa: F401
 from deepspeed_tpu.analysis import dispatchplan  # noqa: F401
+from deepspeed_tpu.analysis import lockwatch  # noqa: F401
 from deepspeed_tpu.analysis import memplan  # noqa: F401
 from deepspeed_tpu.analysis import passes
 from deepspeed_tpu.analysis import profiles  # noqa: F401
 from deepspeed_tpu.analysis import stability  # noqa: F401
+from deepspeed_tpu.analysis.concurrency import ConcurrencyLintError
 from deepspeed_tpu.analysis.dispatchplan import (DispatchPlan,
                                                  plan_engine_dispatch,
                                                  plan_serve_dispatch)
@@ -55,7 +58,8 @@ MODES = ("off", "warn", "error")
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "Report", "GraphLintError",
-    "MemoryPlanError", "ShardSpecError", "MODES", "analyze_jaxpr",
+    "MemoryPlanError", "ShardSpecError", "ConcurrencyLintError", "MODES",
+    "concurrency", "lockwatch", "analyze_jaxpr",
     "analyze_step", "analyze_engine", "analyze_engine_train_batch",
     "analyze_engine_train_many", "trace_train_batch", "train_batch_args",
     "train_many_args", "step_args",

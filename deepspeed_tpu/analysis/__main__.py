@@ -11,6 +11,7 @@ printed.  Static analysis only — no optimizer step runs, no TPU is needed.
     python -m deepspeed_tpu.analysis --mode error examples/*/ds_config*.json
     python -m deepspeed_tpu.analysis --plan --profile v4-8 <config>
     python -m deepspeed_tpu.analysis --plan --json <config>   # CI artifact
+    python -m deepspeed_tpu.analysis --concurrency --mode error  # host lint
 
 ``--plan`` adds the capacity planner: predicted per-device peak HBM of
 the fused train_batch program, the persistent-state breakdown, bytes on
@@ -299,8 +300,10 @@ def main(argv=None) -> int:
         description="Statically analyze the train-step graph a DeepSpeed "
                     "config would build (collectives, precision, "
                     "transfers, shard specs).  See docs/analysis.md.")
-    ap.add_argument("configs", nargs="+",
-                    help="DeepSpeed JSON config file(s) to analyze")
+    ap.add_argument("configs", nargs="*",
+                    help="DeepSpeed JSON config file(s) to analyze "
+                         "(optional with --concurrency, which runs over "
+                         "source files, not configs)")
     ap.add_argument("--mode", choices=("warn", "error"), default="warn",
                     help="'error': exit 2 on error-severity findings "
                          "(the CI gate); 'warn' (default): report only")
@@ -330,16 +333,69 @@ def main(argv=None) -> int:
     ap.add_argument("--profile", default=None,
                     help="backend profile for --plan (v4-8, v5e-8, v5p-8, "
                          "cpu-8; default: the running backend's profile)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the host-concurrency lint (lock-order, "
+                         "blocking-under-lock, thread-role contracts) "
+                         "over the serving control-plane SOURCES — no "
+                         "config needed (docs/analysis.md \"Host "
+                         "concurrency\")")
+    ap.add_argument("--concurrency-path", action="append", default=[],
+                    dest="concurrency_paths", metavar="FILE",
+                    help="analyze these Python files instead of the "
+                         "shipped control plane (repeatable; the "
+                         "seeded-defect tests use this)")
     ap.add_argument("--json", action="store_true", dest="json_out",
                     help="emit one machine-readable JSON line per config "
                          "(findings + plan) instead of the pretty report — "
                          "the CI artifact format")
     args = ap.parse_args(argv)
+    if not args.configs and not args.concurrency:
+        ap.error("no configs given (and --concurrency not requested)")
 
     from deepspeed_tpu import analysis
 
     total_errors = 0
     failed = []
+
+    if args.concurrency:
+        from deepspeed_tpu.analysis import concurrency as conc
+        paths = args.concurrency_paths or conc.control_plane_paths()
+        try:
+            rep = conc.check_paths(paths, suppress=args.suppress)
+        except Exception as e:
+            print(f"== concurrency: ANALYSIS FAILED ==\n   "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            failed.append("--concurrency")
+            rep = None
+        if rep is not None:
+            if args.json_out:
+                print(json.dumps({
+                    "config": None,
+                    "subject": "concurrency",
+                    "mode": args.mode,
+                    "paths": [os.path.relpath(p) for p in paths],
+                    "findings": [{
+                        "code": f.code, "severity": f.severity,
+                        "message": f.message, "path": f.path,
+                        "source": f.source, "pass": f.pass_name,
+                    } for f in rep.sorted()],
+                    "suppressed_count": rep.suppressed_count,
+                    "errors": len(rep.errors),
+                    "warnings": len(rep.warnings),
+                }, sort_keys=True))
+            else:
+                print(f"== concurrency lint: {len(paths)} control-plane "
+                      f"module(s) ==")
+                text = rep.format(
+                    min_severity=analysis.INFO if args.verbose
+                    else analysis.WARNING)
+                if text == "no findings" and rep.infos:
+                    text = (f"no warning/error findings "
+                            f"({len(rep.infos)} info — use --verbose)")
+                print(text)
+                print(rep.summary())
+                print()
+            total_errors += len(rep.errors)
     for path in args.configs:
         try:
             rep, cap, dplans = _analyze_config(

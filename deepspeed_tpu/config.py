@@ -381,7 +381,8 @@ class DeepSpeedConfig:
                 f"{{'mode': ..., 'memory_budget_gb': ..., 'profile': ..., "
                 f"'suppress': [...]}}, got {an!r}")
         an_known = {C.ANALYSIS_MODE, C.ANALYSIS_MEMORY_BUDGET_GB,
-                    C.ANALYSIS_PROFILE, C.ANALYSIS_SUPPRESS}
+                    C.ANALYSIS_PROFILE, C.ANALYSIS_SUPPRESS,
+                    C.ANALYSIS_CONCURRENCY}
         if an is not None and set(an) - an_known:
             # a typo'd budget key would silently run ungated — loud, like
             # the resilience section
@@ -429,6 +430,42 @@ class DeepSpeedConfig:
                 f"{C.ANALYSIS}.{C.ANALYSIS_SUPPRESS} must be a list of "
                 f"rule-code prefixes, got {an_sup!r}")
         self.analysis_suppress = list(an_sup)
+
+        # analysis.concurrency: the host-concurrency lint over the
+        # serving control plane (analysis/concurrency.py), gated at
+        # FleetRouter build.  A bare string is mode shorthand, like
+        # graph_lint
+        cc = an.get(C.ANALYSIS_CONCURRENCY) if an is not None else None
+        if isinstance(cc, str):
+            cc = {C.ANALYSIS_MODE: cc}
+        if cc is not None and not isinstance(cc, Mapping):
+            raise DeepSpeedConfigError(
+                f"'{C.ANALYSIS}.{C.ANALYSIS_CONCURRENCY}' must be a mode "
+                f"string or an object {{'mode': ..., 'suppress': [...]}}, "
+                f"got {cc!r}")
+        cc_known = {C.ANALYSIS_MODE, C.ANALYSIS_SUPPRESS}
+        if cc is not None and set(cc) - cc_known:
+            raise DeepSpeedConfigError(
+                f"unknown {C.ANALYSIS}.{C.ANALYSIS_CONCURRENCY} key(s) "
+                f"{sorted(set(cc) - cc_known)}; supported: "
+                f"{sorted(cc_known)}")
+        self.analysis_concurrency_mode = get_scalar_param(
+            cc, C.ANALYSIS_MODE, C.ANALYSIS_CONCURRENCY_MODE_DEFAULT)
+        if self.analysis_concurrency_mode not in ("off", "warn", "error"):
+            raise DeepSpeedConfigError(
+                f"{C.ANALYSIS}.{C.ANALYSIS_CONCURRENCY}.{C.ANALYSIS_MODE} "
+                f"must be 'off', 'warn' or 'error', got "
+                f"{self.analysis_concurrency_mode!r}")
+        cc_sup = get_scalar_param(
+            cc, C.ANALYSIS_SUPPRESS,
+            C.ANALYSIS_CONCURRENCY_SUPPRESS_DEFAULT)
+        if (not isinstance(cc_sup, (list, tuple))
+                or not all(isinstance(s, str) for s in cc_sup)):
+            raise DeepSpeedConfigError(
+                f"{C.ANALYSIS}.{C.ANALYSIS_CONCURRENCY}."
+                f"{C.ANALYSIS_SUPPRESS} must be a list of rule-code "
+                f"prefixes, got {cc_sup!r}")
+        self.analysis_concurrency_suppress = list(cc_sup)
 
         # resilience: preemption-safe training, hang watchdog, NaN
         # sentinel, storage retry (deepspeed_tpu/resilience/,

@@ -188,6 +188,13 @@ ANALYSIS_PROFILE_DEFAULT = None
 # exact/dotted-prefix semantics as graph_lint.suppress
 ANALYSIS_SUPPRESS = "suppress"
 ANALYSIS_SUPPRESS_DEFAULT = ()
+# host-concurrency lint (analysis/concurrency.py): AST lock-order +
+# blocking-under-lock + thread-role pass over the serving control plane,
+# gated at FleetRouter build.  {"mode": off|warn|error, "suppress":
+# [...]}; a bare string is mode shorthand, like graph_lint
+ANALYSIS_CONCURRENCY = "concurrency"
+ANALYSIS_CONCURRENCY_MODE_DEFAULT = "off"
+ANALYSIS_CONCURRENCY_SUPPRESS_DEFAULT = ()
 
 #############################################
 # Profiler (TPU-native: jax.profiler trace over a step window — the

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.analysis import lockwatch
 from deepspeed_tpu.parallel.topology import MODEL_AXIS
 
 LAYOUTS = ("paged", "ring")
@@ -266,6 +267,17 @@ class PagePool:
     ``rows()`` int32 map per dispatch."""
 
     def __init__(self, spec: KVCacheSpec):
+        # the pool is the ONE serving structure mutated by the driver
+        # thread (admit/release/publish/prepare_write through the
+        # scheduler) while observability threads read gauges() for
+        # /metrics and the router's load signal — every public method
+        # holds this lock.  RLock: admit() re-enters through lookup()
+        # and _take_page()
+        self._lock = lockwatch.named_lock("PagePool._lock", rlock=True)
+        self._init_state(spec)
+
+    # dstpu-thread: construction init
+    def _init_state(self, spec: KVCacheSpec) -> None:
         self.spec = spec
         self.pt = max(1, int(spec.page_tokens))
         self.num_pages = spec.num_pages
@@ -296,39 +308,45 @@ class PagePool:
     @property
     def free_pages(self) -> int:
         """Pages allocatable RIGHT NOW (free + reclaimable LRU)."""
-        return len(self._free) + len(self._lru)
+        with self._lock:
+            return len(self._free) + len(self._lru)
 
     def refcount(self, page: int) -> int:
-        return int(self._ref[page])
+        with self._lock:
+            return int(self._ref[page])
 
     def slot_pages(self, slot: int) -> List[int]:
-        return list(self._alloc[slot])
+        with self._lock:
+            return list(self._alloc[slot])
 
     def shared_pages(self, slot: int) -> int:
         """Leading pages of ``slot`` that were mapped from the index at
         admission (the reused prefix)."""
-        return self._shared[slot]
+        with self._lock:
+            return self._shared[slot]
 
     def is_published(self, page: int) -> bool:
-        return page in self._hash_of
+        with self._lock:
+            return page in self._hash_of
 
     def gauges(self) -> dict:
         """Live pool state as flat numbers — the ``/metrics`` gauges and
         the serve v3 window columns (docs/observability.md "Serving
         view").  Pure host bookkeeping reads, no device interaction."""
-        return {
-            "pool_pages": self.num_pages,
-            "free_pages": self.free_pages,       # allocatable (free+LRU)
-            "lru_pages": len(self._lru),         # published, refcount 0
-            "published_pages": len(self._hash_of),
-            "pages_in_use": int(np.sum(self._ref > 0)),
-            "shared_pages": int(np.sum(self._ref > 1)),
-            "prefix_hits": self.hits,
-            "prefix_tokens_reused": self.tokens_reused,
-            "admission_refusals": self.refusals,
-            "cow_copies": self.cow_copies,
-            "lru_reclaims": self.lru_reclaims,
-        }
+        with self._lock:
+            return {
+                "pool_pages": self.num_pages,
+                "free_pages": len(self._free) + len(self._lru),
+                "lru_pages": len(self._lru),     # published, refcount 0
+                "published_pages": len(self._hash_of),
+                "pages_in_use": int(np.sum(self._ref > 0)),
+                "shared_pages": int(np.sum(self._ref > 1)),
+                "prefix_hits": self.hits,
+                "prefix_tokens_reused": self.tokens_reused,
+                "admission_refusals": self.refusals,
+                "cow_copies": self.cow_copies,
+                "lru_reclaims": self.lru_reclaims,
+            }
 
     def rows(self) -> np.ndarray:
         """The resolved ``[slots, capacity]`` int32 flat-row map the
@@ -339,23 +357,26 @@ class PagePool:
         that aims past the allocation (e.g. a speculative verify block
         wider than the slot's remaining budget) can never touch a page
         another request owns."""
-        if self._rows is None:
-            pages = self._table.astype(np.int64)           # [slots, P]
-            base = pages * self.pt                         # row of page 0
-            offs = np.arange(self.spec.capacity, dtype=np.int64)
-            rows = base[:, offs // self.pt] + (offs % self.pt)[None, :]
-            drop = self.spec.pool_rows
-            for s in range(self.spec.slots):
-                n_alloc = len(self._alloc[s])
-                rows[s, n_alloc * self.pt:] = drop
-            self._rows = rows.astype(np.int32)
-        return self._rows
+        with self._lock:
+            if self._rows is None:
+                pages = self._table.astype(np.int64)       # [slots, P]
+                base = pages * self.pt                     # row of page 0
+                offs = np.arange(self.spec.capacity, dtype=np.int64)
+                rows = base[:, offs // self.pt] \
+                    + (offs % self.pt)[None, :]
+                drop = self.spec.pool_rows
+                for s in range(self.spec.slots):
+                    n_alloc = len(self._alloc[s])
+                    rows[s, n_alloc * self.pt:] = drop
+                self._rows = rows.astype(np.int32)
+            return self._rows
 
     def slot_rows(self, slot: int) -> np.ndarray:
         """Flat rows of one slot's logical [capacity] range."""
         return self.rows()[slot]
 
     # --------------------------------------------------------- allocation
+    # dstpu-thread: internal holds=PagePool._lock
     def _take_page(self) -> Optional[int]:
         if self._free:
             return self._free.pop()
@@ -366,6 +387,7 @@ class PagePool:
             return page
         return None
 
+    # dstpu-thread: internal holds=PagePool._lock
     def _unpublish(self, page: int) -> None:
         h = self._hash_of.pop(page, None)
         if h is not None and self._index.get(h) == page:
@@ -385,13 +407,14 @@ class PagePool:
         if hashes is None:
             hashes = prefix_page_hashes(prompt, self.pt,
                                         max_pages=max_pages)
-        pages = []
-        for h in hashes[:max_pages]:
-            page = self._index.get(h)
-            if page is None:
-                break
-            pages.append(page)
-        return pages
+        with self._lock:
+            pages = []
+            for h in hashes[:max_pages]:
+                page = self._index.get(h)
+                if page is None:
+                    break
+                pages.append(page)
+            return pages
 
     def admit(self, slot: int, prompt: Sequence[int], budget_tokens: int,
               reuse: bool = True) -> Optional[AdmitGrant]:
@@ -402,10 +425,6 @@ class PagePool:
         (the scheduler keeps the request queued; nothing is
         half-allocated).  The ring layout always maps its full window
         (writes wrap within it)."""
-        if self._alloc[slot] or self._shared[slot]:
-            raise RuntimeError(
-                f"slot {slot} admitted while still holding pages — "
-                f"release() first")
         total = len(prompt) + max(0, int(budget_tokens))
         if self.spec.ring:
             pages_needed = self.spec.pages_per_slot
@@ -413,42 +432,47 @@ class PagePool:
             pages_needed = min(-(-total // self.pt),
                                self.spec.pages_per_slot)
         hashes = prefix_page_hashes(prompt, self.pt)   # hashed ONCE
-        hit: List[int] = (self.lookup(prompt, hashes=hashes)
-                          if reuse else [])
-        hit = hit[:pages_needed]
-        n_new = pages_needed - len(hit)
-        # allocatable = free + reclaimable LRU, MINUS the LRU pages this
-        # very admission is about to revive as hits — counting them as
-        # reclaimable would pass the check and then run the allocator
-        # dry mid-admission
-        lru_hits = sum(1 for p in hit if self._ref[p] == 0)
-        if n_new > len(self._free) + len(self._lru) - lru_hits:
-            self.refusals += 1
-            return None
-        for page in hit:
-            if self._ref[page] == 0:
-                self._lru.pop(page, None)      # revive from the LRU
-            self._ref[page] += 1
-        fresh = []
-        for _ in range(n_new):
-            page = self._take_page()
-            assert page is not None, "refusal check out of sync"
-            fresh.append(page)
-        for page in fresh:
-            self._ref[page] += 1
-        pages = hit + fresh
-        self._alloc[slot] = pages
-        self._shared[slot] = len(hit)
-        self._table[slot, :len(pages)] = np.asarray(pages, np.int32)
-        self._table[slot, len(pages):] = 0
-        self._rows = None
-        reused_tokens = len(hit) * self.pt
-        if reuse:
-            self.hits += 1 if hit else 0
-            self.tokens_reused += reused_tokens
-        return AdmitGrant(slot=slot, reused_tokens=reused_tokens,
-                          reused_pages=len(hit), new_pages=n_new,
-                          hashes=hashes, prompt_tokens=len(prompt))
+        with self._lock:
+            if self._alloc[slot] or self._shared[slot]:
+                raise RuntimeError(
+                    f"slot {slot} admitted while still holding pages — "
+                    f"release() first")
+            hit: List[int] = (self.lookup(prompt, hashes=hashes)
+                              if reuse else [])
+            hit = hit[:pages_needed]
+            n_new = pages_needed - len(hit)
+            # allocatable = free + reclaimable LRU, MINUS the LRU pages
+            # this very admission is about to revive as hits — counting
+            # them as reclaimable would pass the check and then run the
+            # allocator dry mid-admission
+            lru_hits = sum(1 for p in hit if self._ref[p] == 0)
+            if n_new > len(self._free) + len(self._lru) - lru_hits:
+                self.refusals += 1
+                return None
+            for page in hit:
+                if self._ref[page] == 0:
+                    self._lru.pop(page, None)    # revive from the LRU
+                self._ref[page] += 1
+            fresh = []
+            for _ in range(n_new):
+                page = self._take_page()
+                assert page is not None, "refusal check out of sync"
+                fresh.append(page)
+            for page in fresh:
+                self._ref[page] += 1
+            pages = hit + fresh
+            self._alloc[slot] = pages
+            self._shared[slot] = len(hit)
+            self._table[slot, :len(pages)] = np.asarray(pages, np.int32)
+            self._table[slot, len(pages):] = 0
+            self._rows = None
+            reused_tokens = len(hit) * self.pt
+            if reuse:
+                self.hits += 1 if hit else 0
+                self.tokens_reused += reused_tokens
+            return AdmitGrant(slot=slot, reused_tokens=reused_tokens,
+                              reused_pages=len(hit), new_pages=n_new,
+                              hashes=hashes, prompt_tokens=len(prompt))
 
     def publish(self, grant: AdmitGrant) -> None:
         """Index ``grant``'s full prompt pages for future hits — call
@@ -457,32 +481,35 @@ class PagePool:
         skipped (first writer wins).  Ring layouts publish too — their
         wrap-around is fenced by :meth:`prepare_write`, which
         un-publishes (or copies) a page before its content diverges."""
-        pages = self._alloc[grant.slot]
-        for i, h in enumerate(grant.hashes):
-            if i >= len(pages):
-                break
-            page = pages[i]
-            if h in self._index or page in self._hash_of:
-                continue
-            self._index[h] = page
-            self._hash_of[page] = h
+        with self._lock:
+            pages = self._alloc[grant.slot]
+            for i, h in enumerate(grant.hashes):
+                if i >= len(pages):
+                    break
+                page = pages[i]
+                if h in self._index or page in self._hash_of:
+                    continue
+                self._index[h] = page
+                self._hash_of[page] = h
 
     def release(self, slot: int) -> None:
         """Eviction: refcount-- every page the slot references; a page
         reaching 0 parks on the LRU when published (still hittable) or
         returns to the free list."""
-        for page in self._alloc[slot]:
-            self._ref[page] -= 1
-            assert self._ref[page] >= 0, f"refcount underflow on {page}"
-            if self._ref[page] == 0:
-                if page in self._hash_of:
-                    self._lru[page] = None
-                else:
-                    self._free.append(page)
-        self._alloc[slot] = []
-        self._shared[slot] = 0
-        self._table[slot, :] = 0
-        self._rows = None
+        with self._lock:
+            for page in self._alloc[slot]:
+                self._ref[page] -= 1
+                assert self._ref[page] >= 0, \
+                    f"refcount underflow on {page}"
+                if self._ref[page] == 0:
+                    if page in self._hash_of:
+                        self._lru[page] = None
+                    else:
+                        self._free.append(page)
+            self._alloc[slot] = []
+            self._shared[slot] = 0
+            self._table[slot, :] = 0
+            self._rows = None
 
     # ------------------------------------------------------ copy-on-write
     def prepare_write(self, slot: int, write_positions) -> List[tuple]:
@@ -498,34 +525,37 @@ class PagePool:
         if not self.spec.ring:
             return copies
         cap = self.spec.capacity
-        pages = self._alloc[slot]
-        seen = set()
-        for p_abs in write_positions:
-            pi = (int(p_abs) % cap) // self.pt
-            if pi in seen or pi >= len(pages):
-                continue
-            seen.add(pi)
-            page = pages[pi]
-            if self._ref[page] > 1:
-                fresh = self._take_page()
-                if fresh is None:
-                    raise RuntimeError(
-                        "page pool exhausted during copy-on-write — "
-                        "lower inference.max_slots or raise pool_pages")
-                self._ref[page] -= 1
-                self._ref[fresh] += 1
-                pages[pi] = fresh
-                self._table[slot, pi] = fresh
-                if pi < self._shared[slot]:
-                    self._shared[slot] = pi
-                self._rows = None
-                self.cow_copies += 1
-                copies.append((page, fresh))
-            elif page in self._hash_of:
-                # sole owner about to overwrite a published page: the
-                # indexed hash no longer describes the content
-                self._unpublish(page)
-        return copies
+        with self._lock:
+            pages = self._alloc[slot]
+            seen = set()
+            for p_abs in write_positions:
+                pi = (int(p_abs) % cap) // self.pt
+                if pi in seen or pi >= len(pages):
+                    continue
+                seen.add(pi)
+                page = pages[pi]
+                if self._ref[page] > 1:
+                    fresh = self._take_page()
+                    if fresh is None:
+                        raise RuntimeError(
+                            "page pool exhausted during copy-on-write "
+                            "— lower inference.max_slots or raise "
+                            "pool_pages")
+                    self._ref[page] -= 1
+                    self._ref[fresh] += 1
+                    pages[pi] = fresh
+                    self._table[slot, pi] = fresh
+                    if pi < self._shared[slot]:
+                        self._shared[slot] = pi
+                    self._rows = None
+                    self.cow_copies += 1
+                    copies.append((page, fresh))
+                elif page in self._hash_of:
+                    # sole owner about to overwrite a published page:
+                    # the indexed hash no longer describes the content
+                    self._unpublish(page)
+            return copies
 
     def reset(self) -> None:
-        self.__init__(self.spec)
+        with self._lock:
+            self._init_state(self.spec)

@@ -262,6 +262,12 @@ class ServeObservability:
                 if isinstance(val, (int, float)) \
                         and not isinstance(val, bool):
                     out[f"window_{name}"] = val
+        from deepspeed_tpu.analysis import lockwatch
+        if lockwatch.armed():
+            # lock sanitizer counters: which control-plane lock is hot,
+            # straight off /metrics (docs/analysis.md "Host concurrency")
+            for k, v in lockwatch.counters().items():
+                out[f"lockwatch_{k}"] = v
         return out
 
     def close(self) -> None:

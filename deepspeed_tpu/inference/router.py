@@ -66,6 +66,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.analysis import concurrency, lockwatch
 from deepspeed_tpu.inference import kvcache
 from deepspeed_tpu.inference.scheduler import (ContinuousScheduler,
                                                KVHandoff, Request,
@@ -370,7 +371,17 @@ class Replica:
                          self.rid, e)
 
     def close(self) -> None:
+        """Stop the driver thread and ONLY THEN tear down the
+        endpoints: the drive loop reads ``self.obs``/``self.sched``
+        mid-tick, so closing the observability server under a live
+        driver races a completion against a dead scheduler.  Bounded
+        join — a wedged thread is daemonic and dies with the process.
+        Never called FROM the driver thread (joining yourself
+        deadlocks), which the current-thread guard enforces."""
         self.stop.set()
+        if self.thread.is_alive() \
+                and self.thread is not threading.current_thread():
+            self.thread.join(timeout=10)
         if self.obs is not None:
             self.obs.close()
 
@@ -393,7 +404,7 @@ class RouterTelemetry:
     def emit(self) -> dict:
         r = self.router
         now = time.perf_counter()
-        with r._lock:
+        with r._lock:  # dstpu-lock: FleetRouter._lock
             tokens = r.tokens_out
             completed = len(r.results)
             ttft, _, queue_wait = request_latency_ms(r.results)
@@ -479,7 +490,7 @@ class RouterObservability:
                                  "healthy": rep.healthy(
                                      max_age=_CACHE_ANY_AGE)}
                   for rep in r.all_replicas}
-        with r._lock:
+        with r._lock:  # dstpu-lock: FleetRouter._lock
             out = {
                 "healthy": ok,
                 "n_replicas": len(r.all_replicas),
@@ -504,7 +515,7 @@ class RouterObservability:
         ok = self.healthy()
         n_healthy = sum(rep.healthy(max_age=_CACHE_ANY_AGE)
                         for rep in r.all_replicas)
-        with r._lock:
+        with r._lock:  # dstpu-lock: FleetRouter._lock
             out = {
                 "healthy": 1 if ok else 0,
                 "n_replicas": len(r.all_replicas),
@@ -566,6 +577,13 @@ class FleetRouter:
             raise ValueError("FleetRouter needs at least one decode/"
                              "mixed replica engine")
         cfg = engines[0].config
+        # build-time gate (memoized per process): with config
+        # analysis.concurrency on, lint the control-plane sources BEFORE
+        # standing up the thread fleet they describe — error mode
+        # refuses to build on an error-severity finding
+        concurrency.check_control_plane(
+            cfg.analysis_concurrency_mode,
+            cfg.analysis_concurrency_suppress, where="FleetRouter")
         self.sampler = sampler
         if prefill_engines and sampler is not greedy_sampler:
             raise ValueError(
@@ -625,7 +643,11 @@ class FleetRouter:
             from deepspeed_tpu.observability.registry import JsonlSink
             self._sink = JsonlSink(jsonl_path)
 
-        self._lock = threading.Lock()
+        # created through the lockwatch factory: a plain Lock unless
+        # the sanitizer is armed (DSTPU_LOCKWATCH=1 / instrument()),
+        # then an InstrumentedLock recording order edges and wait/held
+        # durations under this canonical name
+        self._lock = lockwatch.named_lock("FleetRouter._lock")
         self._queue = deque()          # (request, t_enqueue) unassigned
         self._inflight = {}            # rid -> _Flight
         self.results: List[RequestResult] = []
@@ -714,6 +736,7 @@ class FleetRouter:
             self.submitted += 1
 
     # --------------------------------------------------------- callbacks
+    # dstpu-thread: driver-callback owner-check=owner
     def _complete(self, replica: Replica, result: RequestResult) -> None:
         """Driver-thread completion: accepted only from the CURRENT
         owner — a zombie replica un-sticking after eviction must not
@@ -730,33 +753,36 @@ class FleetRouter:
             self.results.append(result)
             self.tokens_out += len(result.tokens)
 
+    # dstpu-thread: prefill-callback owner-check=owner
     def _handoff(self, prefill_rep: Replica, req, t_enq,
                  path: str) -> None:
         """Prefill-thread handoff: route the sealed artifact to the
-        least-loaded healthy DECODE replica (ownership moves with it)."""
+        least-loaded healthy DECODE replica (ownership moves with it).
+        The critical section is bookkeeping ONLY — the artifact unlink
+        (file IO) happens after the lock is released, or every
+        completion callback in the fleet stalls behind the filesystem."""
+        target = None
         with self._lock:
             flight = self._inflight.get(req.rid)
             if flight is None or flight.owner is not prefill_rep:
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
-                return
-            target = self._pick(self.replicas, req, record_affinity=False)
-            if target is None:
+                pass                  # ownership moved: drop the artifact
+            elif (target := self._pick(self.replicas, req,
+                                       record_affinity=False)) is None:
                 # no healthy decode replica RIGHT NOW: requeue at the
                 # router with the original timestamp; the tick loop
                 # re-dispatches (possibly re-prefilling elsewhere)
                 del self._inflight[req.rid]
                 self._queue.appendleft((req, t_enq))
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
-                return
-            flight.owner = target
-            flight.phase = "decode"
-            self.handoffs += 1
+            else:
+                flight.owner = target
+                flight.phase = "decode"
+                self.handoffs += 1
+        if target is None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return
         target.inbox.put(("kvh", path, req.rid))
         if target.dead:
             # raced an eviction: _evict's inbox drain may have run
@@ -768,6 +794,7 @@ class FleetRouter:
             except OSError:
                 pass
 
+    # dstpu-thread: decode-callback owner-check=owner
     def _handoff_read_failed(self, replica: Replica, rid: int) -> None:
         """Decode-thread report of a corrupt handoff artifact: the ONE
         affected request re-enters the fleet queue with its original
@@ -786,6 +813,7 @@ class FleetRouter:
         return kvcache.prefix_page_hashes(
             prompt, self._page_tokens, max_pages=_AFFINITY_MAX_PAGES)
 
+    # dstpu-thread: admission holds=FleetRouter._lock
     def _pick(self, pool: List[Replica], req,
               record_affinity: bool = True) -> Optional[Replica]:
         """Admission policy (call with the lock held): prefix affinity

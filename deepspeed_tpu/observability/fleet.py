@@ -112,6 +112,7 @@ class FleetAggregator:
         self._thread.start()
 
     # ------------------------------------------------------------- publish
+    # dstpu-thread: drain-callback enqueue-only
     def publish(self, ordinal: int, report: dict) -> None:
         """Hand one window report off (drain-callback side: enqueue only —
         the KV write is a network RPC and must not ride the runtime's

@@ -1,0 +1,722 @@
+"""Host-concurrency analyzer (deepspeed_tpu/analysis/concurrency.py +
+lockwatch.py, docs/analysis.md "Host concurrency").
+
+The load-bearing pins:
+
+* **Seeded defects are caught with file:line messages** — a lock-order
+  inversion, an HTTP probe under a lock (the revert-twin of the PR 15
+  ``_pick`` bug), and a cross-thread unlocked mutation each raise in
+  error mode, and their fixed twins lint clean.
+* **The shipped control plane is clean** — zero error-severity findings
+  over the real router/scheduler/kvcache/observability/resilience
+  modules (real findings were FIXED, not suppressed), so the CI
+  ``concurrency-lint`` job gates on a true baseline.
+* **The runtime sanitizer agrees with the static pass** — lockwatch's
+  observed acquisition-order edges merge into the static graph without
+  creating a cycle, its counters export through the registry shape, and
+  long waits leave ``lock_wait`` flight-recorder breadcrumbs.
+* **PagePool survives concurrent admit/evict/COW** — refcounts sum
+  exactly and the free list never double-enters a page under scheduler
+  threads with lockwatch armed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import analysis
+from deepspeed_tpu.analysis import concurrency as conc
+from deepspeed_tpu.analysis import lockwatch
+from deepspeed_tpu.analysis import report as lint_report
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch_clean():
+    """Every test starts disarmed with empty observation state."""
+    lockwatch.instrument(False)
+    lockwatch.reset()
+    lockwatch.configure(wait_warn_ms=lockwatch.DEFAULT_WAIT_WARN_MS,
+                        hold_warn_ms=lockwatch.DEFAULT_HOLD_WARN_MS)
+    yield
+    lockwatch.instrument(False)
+    lockwatch.reset()
+    lockwatch.configure(wait_warn_ms=lockwatch.DEFAULT_WAIT_WARN_MS,
+                        hold_warn_ms=lockwatch.DEFAULT_HOLD_WARN_MS)
+
+
+def _lint(tmp_path, source, name="mod_under_test.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return conc.check_paths([str(path)]), str(path)
+
+
+# ---------------------------------------------------------------------------
+# seeded defect class 1: lock-order inversion
+# ---------------------------------------------------------------------------
+
+INVERSION = """\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def forward(self):
+        with self._lock_a:
+            with self._lock_b:
+                return 1
+
+    def backward(self):
+        with self._lock_b:
+            with self._lock_a:
+                return 2
+"""
+
+
+def test_lock_order_inversion_is_an_error(tmp_path):
+    rep, path = _lint(tmp_path, INVERSION)
+    errs = [f for f in rep.errors if f.code == "concurrency.lock-order"]
+    assert errs, rep.format("info")
+    msg = errs[0].message
+    assert "Pair._lock_a" in msg and "Pair._lock_b" in msg
+    # the cycle message names a concrete file:line edge site
+    assert f"{path}:" in msg or (errs[0].source or "").startswith(path)
+
+
+def test_lock_order_fixed_twin_is_clean(tmp_path):
+    fixed = INVERSION.replace(
+        "        with self._lock_b:\n            with self._lock_a:",
+        "        with self._lock_a:\n            with self._lock_b:")
+    rep, _ = _lint(tmp_path, fixed)
+    assert not rep.errors and not rep.warnings, rep.format("info")
+
+
+def test_self_deadlock_reacquire_is_an_error(tmp_path):
+    rep, path = _lint(tmp_path, """\
+import threading
+
+class Once:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            return self.inner()
+
+    def inner(self):
+        with self._lock:
+            return 1
+""")
+    errs = [f for f in rep.errors if f.code == "concurrency.lock-order"]
+    assert errs and "self-deadlock" in errs[0].message
+    # an RLock version is legal
+    rep2, _ = _lint(tmp_path, """\
+import threading
+
+class Once:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            return self.inner()
+
+    def inner(self):
+        with self._lock:
+            return 1
+""", name="mod_rlock.py")
+    assert not rep2.errors, rep2.format("info")
+
+
+# ---------------------------------------------------------------------------
+# seeded defect class 2: blocking under a lock (the PR 15 _pick twin)
+# ---------------------------------------------------------------------------
+
+HTTP_UNDER_LOCK = """\
+import threading
+import urllib.request
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas = []
+
+    def pick(self):
+        with self._lock:
+            for rep in self.replicas:
+                urllib.request.urlopen(rep, timeout=2.0)
+            return self.replicas[0] if self.replicas else None
+"""
+
+
+def test_http_probe_under_lock_is_an_error(tmp_path):
+    rep, path = _lint(tmp_path, HTTP_UNDER_LOCK)
+    errs = [f for f in rep.errors
+            if f.code == "concurrency.blocking-under-lock"]
+    assert errs, rep.format("info")
+    assert "Router._lock" in errs[0].message
+    # file:line in the source so the finding is actionable
+    assert errs[0].source.startswith(f"{path}:12"), errs[0].source
+
+
+def test_http_probe_outside_lock_is_clean(tmp_path):
+    fixed = """\
+import threading
+import urllib.request
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas = []
+
+    def pick(self):
+        with self._lock:
+            reps = list(self.replicas)
+        for rep in reps:
+            urllib.request.urlopen(rep, timeout=2.0)
+        return reps[0] if reps else None
+"""
+    rep, _ = _lint(tmp_path, fixed)
+    assert not rep.errors, rep.format("info")
+
+
+def test_blocking_through_a_resolved_call_is_caught(tmp_path):
+    rep, path = _lint(tmp_path, """\
+import threading
+import time
+
+class Slow:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nap_helper(self):
+        time.sleep(1.0)
+
+    def tick(self):
+        with self._lock:
+            self.nap_helper()
+""")
+    errs = [f for f in rep.errors
+            if f.code == "concurrency.blocking-under-lock"]
+    assert errs, rep.format("info")
+    # the propagated finding names BOTH the call site and the sleep site
+    assert "nap_helper" in errs[0].message
+    assert "time.sleep" in errs[0].message
+
+
+def test_allow_blocking_annotation_downgrades_to_info(tmp_path):
+    allowed = HTTP_UNDER_LOCK.replace(
+        "                urllib.request.urlopen(rep, timeout=2.0)",
+        "                urllib.request.urlopen(rep, timeout=2.0)"
+        "  # dstpu-lock: allow-blocking(test fixture)")
+    rep, _ = _lint(tmp_path, allowed)
+    assert not rep.errors, rep.format("info")
+    assert any(f.code == "concurrency.allowed-blocking"
+               for f in rep.infos)
+
+
+# ---------------------------------------------------------------------------
+# seeded defect class 3: cross-thread unlocked mutation
+# ---------------------------------------------------------------------------
+
+UNLOCKED_WRITE = """\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset_fast(self):
+        self.total = 0
+"""
+
+
+def test_unlocked_guarded_write_is_an_error(tmp_path):
+    rep, path = _lint(tmp_path, UNLOCKED_WRITE)
+    errs = [f for f in rep.errors
+            if f.code == "concurrency.unlocked-guarded-write"]
+    assert errs, rep.format("info")
+    assert "total" in errs[0].message
+    assert errs[0].source.startswith(f"{path}:13"), errs[0].source
+
+
+def test_guarded_write_fixed_twin_is_clean(tmp_path):
+    fixed = UNLOCKED_WRITE.replace(
+        "    def reset_fast(self):\n        self.total = 0",
+        "    def reset_fast(self):\n        with self._lock:\n"
+        "            self.total = 0")
+    rep, _ = _lint(tmp_path, fixed)
+    assert not rep.errors, rep.format("info")
+
+
+def test_init_annotated_function_is_exempt(tmp_path):
+    rep, _ = _lint(tmp_path, UNLOCKED_WRITE.replace(
+        "    def reset_fast(self):",
+        "    # dstpu-thread: construction init\n"
+        "    def reset_fast(self):"))
+    assert not rep.errors, rep.format("info")
+
+
+# ---------------------------------------------------------------------------
+# thread-role contracts
+# ---------------------------------------------------------------------------
+
+def test_holds_contract_checks_callers(tmp_path):
+    rep, _ = _lint(tmp_path, """\
+import threading
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.m = {}
+
+    # dstpu-thread: admission holds=R._lock
+    def pick(self):
+        self.m["k"] = 1
+        return 1
+
+    def good(self):
+        with self._lock:
+            return self.pick()
+
+    def bad(self):
+        return self.pick()
+""")
+    errs = [f for f in rep.errors
+            if f.code == "concurrency.lock-contract"]
+    assert len(errs) == 1, rep.format("info")
+    assert "R.bad" in errs[0].source
+    assert "holds=R._lock" in errs[0].message
+
+
+def test_enqueue_only_rejects_blocking_and_locks(tmp_path):
+    rep, _ = _lint(tmp_path, """\
+import threading
+import time
+
+class Agg:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    # dstpu-thread: drain-callback enqueue-only
+    def publish(self, item):
+        with self._lock:
+            time.sleep(0.1)
+""")
+    codes = {f.code for f in rep.errors}
+    assert "concurrency.thread-role" in codes, rep.format("info")
+    roles = [f for f in rep.errors if f.code == "concurrency.thread-role"]
+    msgs = " | ".join(f.message for f in roles)
+    assert "enqueue-only" in msgs
+    assert "acquires Agg._lock" in msgs
+
+
+def test_owner_check_contract(tmp_path):
+    rep, _ = _lint(tmp_path, """\
+import threading
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.flights = {}
+
+    # dstpu-thread: driver-callback owner-check=owner
+    def complete(self, replica, rid):
+        with self._lock:
+            del self.flights[rid]
+""")
+    errs = [f for f in rep.errors if f.code == "concurrency.thread-role"]
+    assert errs and "owner-check=owner" in errs[0].message
+    rep2, _ = _lint(tmp_path, """\
+import threading
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.flights = {}
+
+    # dstpu-thread: driver-callback owner-check=owner
+    def complete(self, replica, rid):
+        with self._lock:
+            f = self.flights.get(rid)
+            if f is None or f.owner is not replica:
+                return
+            del self.flights[rid]
+""", name="mod_owner_ok.py")
+    assert not rep2.errors, rep2.format("info")
+
+
+def test_dangling_annotation_is_a_warning(tmp_path):
+    rep, _ = _lint(tmp_path, """\
+import threading
+
+X = 1
+# dstpu-thread: orphan-role enqueue-only
+Y = 2
+""")
+    assert any(f.code == "concurrency.annotation" for f in rep.warnings)
+
+
+# ---------------------------------------------------------------------------
+# the shipped control plane: clean, and gated
+# ---------------------------------------------------------------------------
+
+def test_shipped_control_plane_has_zero_findings():
+    """The acceptance pin: real findings were FIXED (the router handoff
+    unlink moved off the lock, PagePool grew its lock), not suppressed —
+    so warn set AND error set are empty over the real modules."""
+    rep = conc.check_paths()
+    assert not rep.errors, rep.format("warning")
+    assert not rep.warnings, rep.format("warning")
+
+
+def test_static_model_covers_the_real_locks():
+    model, rep = conc.analyze_paths(conc.control_plane_paths())
+    names = set(model.locks)
+    for expected in ("FleetRouter._lock", "PagePool._lock",
+                     "MetricRegistry._lock", "FleetAggregator._lock",
+                     "Watchdog._lock", "FlightRecorder._lock"):
+        assert expected in names, sorted(names)
+    # the shipped thread-role contracts are attached (not dangling)
+    roles = set(model.roles)
+    assert "router.FleetRouter._complete" in roles
+    assert "router.FleetRouter._pick" in roles
+    assert "fleet.FleetAggregator.publish" in roles
+
+
+def test_error_mode_raises_concurrency_lint_error(tmp_path):
+    rep, _ = _lint(tmp_path, INVERSION)
+    with pytest.raises(analysis.ConcurrencyLintError) as ei:
+        analysis.dispatch_report(rep, "error", where="test",
+                                 label="concurrency lint",
+                                 error_cls=conc.ConcurrencyLintError)
+    assert "concurrency.lock-order" in str(ei.value)
+    # warn mode only logs
+    analysis.dispatch_report(rep, "warn", where="test",
+                             label="concurrency lint",
+                             error_cls=conc.ConcurrencyLintError)
+
+
+def test_suppress_uses_report_prefix_semantics(tmp_path):
+    path = tmp_path / "m.py"
+    path.write_text(INVERSION)
+    rep = conc.check_paths([str(path)],
+                           suppress=["concurrency.lock-order"])
+    assert not rep.errors
+    assert rep.suppressed_count >= 1
+
+
+def test_config_wires_analysis_concurrency():
+    from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+    def build(**analysis):
+        return DeepSpeedConfig(
+            {"train_batch_size": 4, "analysis": analysis}
+            if analysis else {"train_batch_size": 4}, dp_world_size=1)
+
+    c = build(concurrency="error")
+    assert c.analysis_concurrency_mode == "error"
+    c = build(concurrency={"mode": "warn",
+                           "suppress": ["concurrency.lock-order"]})
+    assert c.analysis_concurrency_mode == "warn"
+    assert c.analysis_concurrency_suppress == ["concurrency.lock-order"]
+    c = build()
+    assert c.analysis_concurrency_mode == "off"
+    with pytest.raises(DeepSpeedConfigError):
+        build(concurrency={"oops": 1})
+    with pytest.raises(DeepSpeedConfigError):
+        build(concurrency="everything")
+
+
+def test_cli_concurrency_error_mode_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    bad = tmp_path / "seeded.py"
+    bad.write_text(HTTP_UNDER_LOCK)
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.analysis", "--concurrency",
+         "--concurrency-path", str(bad), "--mode", "error", "--json"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 2, out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["subject"] == "concurrency" and doc["errors"] >= 1
+    codes = {f["code"] for f in doc["findings"]}
+    assert "concurrency.blocking-under-lock" in codes
+    # shipped modules: exit 0
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.analysis", "--concurrency",
+         "--mode", "error"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# shutdown ordering (the PR hardening of Replica.close)
+# ---------------------------------------------------------------------------
+
+def test_replica_close_joins_driver_before_endpoint_teardown():
+    """Regression pin: close() must stop + JOIN the driver thread and
+    only then tear down the observability endpoints — a driver mid-
+    dispatch must never see its health server vanish under it."""
+    from deepspeed_tpu.inference.router import Replica
+    order = []
+    rep = object.__new__(Replica)
+    rep.stop = threading.Event()
+    started = threading.Event()
+
+    def drive():
+        started.set()
+        rep.stop.wait(timeout=10)
+        time.sleep(0.05)
+        order.append("driver-exit")
+
+    rep.thread = threading.Thread(target=drive, daemon=True)
+
+    class Obs:
+        def close(self):
+            order.append(("obs-close", rep.thread.is_alive()))
+
+    rep.obs = Obs()
+    rep.thread.start()
+    assert started.wait(timeout=5)
+    rep.close()
+    assert rep.stop.is_set()
+    assert order == ["driver-exit", ("obs-close", False)], order
+
+
+def test_replica_close_from_its_own_driver_thread_does_not_join_self():
+    from deepspeed_tpu.inference.router import Replica
+    closed = threading.Event()
+    rep = object.__new__(Replica)
+    rep.stop = threading.Event()
+    rep.obs = None
+
+    def drive():
+        rep.close()      # eviction path: the driver closes its replica
+        closed.set()
+
+    rep.thread = threading.Thread(target=drive, daemon=True)
+    rep.thread.start()
+    assert closed.wait(timeout=5), "close() deadlocked joining itself"
+    rep.thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# lockwatch: the runtime half
+# ---------------------------------------------------------------------------
+
+def test_named_lock_plain_when_disarmed():
+    lk = lockwatch.named_lock("T._lock")
+    assert not isinstance(lk, lockwatch.InstrumentedLock)
+    with lk:
+        pass
+
+
+def test_instrumented_lock_records_stats_and_edges():
+    lockwatch.instrument(True)
+    a = lockwatch.named_lock("T._a")
+    b = lockwatch.named_lock("T._b")
+    assert isinstance(a, lockwatch.InstrumentedLock)
+    with a:
+        with b:
+            pass
+    with a:
+        pass
+    snap = lockwatch.snapshot()
+    assert snap["T._a"]["acquisitions"] == 2
+    assert snap["T._b"]["acquisitions"] == 1
+    assert ("T._a", "T._b") in lockwatch.observed_edges()
+    assert ("T._b", "T._a") not in lockwatch.observed_edges()
+    counters = lockwatch.counters()
+    assert counters["lock_acquisitions.T._a"] == 2
+    assert "lock_wait_ms.T._b" in counters
+    assert "lock_held_ms.T._a" in counters
+
+
+def test_instrumented_rlock_reentry_counts_once():
+    lockwatch.instrument(True)
+    lk = lockwatch.named_lock("T._r", rlock=True)
+    with lk:
+        with lk:
+            assert lk.locked()
+    assert not lk.locked()
+    assert lockwatch.snapshot()["T._r"]["acquisitions"] == 1
+    assert ("T._r", "T._r") not in lockwatch.observed_edges()
+
+
+def test_contended_wait_leaves_a_flight_recorder_breadcrumb():
+    from deepspeed_tpu.observability.flightrec import RECORDER
+    lockwatch.instrument(True)
+    lockwatch.configure(wait_warn_ms=1.0, hold_warn_ms=10_000.0)
+    lk = lockwatch.named_lock("T._contended")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            release.wait(timeout=5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert entered.wait(timeout=5)
+    waiter_done = threading.Event()
+    rows = []
+
+    def waiter():
+        threading.Timer(0.05, release.set).start()
+        with lk:
+            pass
+        waiter_done.set()
+
+    w = threading.Thread(target=waiter, daemon=True, name="t-waiter")
+    w.start()
+    assert waiter_done.wait(timeout=5)
+    t.join(timeout=5)
+    rows = [r for r in RECORDER.tail(64)
+            if r.get("kind") == "lock_wait"
+            and r.get("lock") == "T._contended"]
+    assert rows, "no lock_wait breadcrumb for the contended acquire"
+    row = rows[-1]
+    assert row["waiter"] == "t-waiter"
+    assert row["wait_ms"] >= 1.0
+    assert lockwatch.snapshot()["T._contended"]["contentions"] >= 1
+
+
+def test_long_hold_leaves_a_lock_held_breadcrumb():
+    from deepspeed_tpu.observability.flightrec import RECORDER
+    lockwatch.instrument(True)
+    lockwatch.configure(wait_warn_ms=10_000.0, hold_warn_ms=0.0)
+    lk = lockwatch.named_lock("T._held")
+    with lk:
+        pass
+    rows = [r for r in RECORDER.tail(64)
+            if r.get("kind") == "lock_held"
+            and r.get("lock") == "T._held"]
+    assert rows and rows[-1]["held_ms"] >= 0.0
+
+
+def test_register_metrics_exports_through_the_registry():
+    from deepspeed_tpu.observability.registry import MetricRegistry
+    lockwatch.instrument(True)
+    lk = lockwatch.named_lock("T._m")
+    with lk:
+        pass
+    reg = MetricRegistry()
+    lockwatch.register_metrics(reg)
+    snap = reg.collect()
+    assert snap["lockwatch"]["lock_acquisitions.T._m"] == 1
+
+
+def test_merge_observed_flags_a_runtime_only_inversion():
+    model, _ = conc.analyze_paths(conc.control_plane_paths())
+    # the static edges alone stay acyclic
+    assert not conc.merge_observed(model, set()).errors
+    # consistency contract: edges in the STATIC direction merge clean
+    assert not conc.merge_observed(
+        model, {("MetricSpool._lock", "MetricRegistry._lock")}).errors
+    # a runtime edge OPPOSING a static edge is the deadlock the AST
+    # could not prove — merge_observed must fail it
+    rep = conc.merge_observed(
+        model, {("MetricRegistry._lock", "MetricSpool._lock")})
+    errs = [f for f in rep.errors if f.code == "concurrency.lock-order"]
+    assert errs and "observed at runtime" in errs[0].message
+
+
+# ---------------------------------------------------------------------------
+# PagePool under concurrent threads with lockwatch armed
+# ---------------------------------------------------------------------------
+
+def test_pagepool_refcount_integrity_under_concurrency():
+    from deepspeed_tpu.inference.kvcache import KVCacheSpec, PagePool
+    lockwatch.instrument(True)
+    spec = KVCacheSpec(layers=1, slots=8, capacity=64, kv_heads_local=1,
+                       head_dim=8, page_tokens=8, pool_pages=48)
+    pool = PagePool(spec)
+    assert isinstance(pool._lock, lockwatch.InstrumentedLock)
+    stop = threading.Event()
+    failures = []
+
+    def worker(slot, seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(120):
+                prompt = [int(x) for x in
+                          rng.integers(0, 4, rng.integers(8, 33))]
+                grant = pool.admit(slot, prompt,
+                                   int(rng.integers(0, 16)))
+                if grant is None:
+                    continue
+                if rng.random() < 0.5:
+                    pool.publish(grant)
+                pool.prepare_write(slot, range(len(prompt),
+                                               len(prompt) + 4))
+                pool.release(slot)
+        except Exception as e:  # pragma: no cover - the failure signal
+            failures.append(e)
+
+    def reader():
+        while not stop.is_set():
+            g = pool.gauges()
+            assert g["free_pages"] >= 0
+            pool.rows()
+
+    threads = [threading.Thread(target=worker, args=(s, 100 + s),
+                                daemon=True) for s in range(spec.slots)]
+    r = threading.Thread(target=reader, daemon=True)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    stop.set()
+    r.join(timeout=10)
+    assert not failures, failures
+    assert not any(t.is_alive() for t in threads)
+
+    # refcounts sum exactly: every page's refcount == the number of
+    # slot allocations referencing it (all slots released -> all zero)
+    counts = np.zeros_like(pool._ref)
+    for alloc in pool._alloc:
+        for page in alloc:
+            counts[page] += 1
+    assert np.array_equal(pool._ref, counts), (pool._ref, counts)
+    assert int(pool._ref.sum()) == 0
+    # no free-list double entry, and free/LRU/refcounted partition the
+    # page space without overlap
+    assert len(set(pool._free)) == len(pool._free)
+    assert not (set(pool._free) & set(pool._lru))
+    assert len(pool._free) + len(pool._lru) == spec.num_pages
+    # the sanitizer actually watched: the pool lock has traffic, and the
+    # observed order edges stay consistent with the static graph
+    assert lockwatch.snapshot()["PagePool._lock"]["acquisitions"] > 0
+    model, _ = conc.analyze_paths(conc.control_plane_paths())
+    assert not conc.merge_observed(model,
+                                   lockwatch.observed_edges()).errors
+
+
+def test_pagepool_reset_preserves_the_lock():
+    from deepspeed_tpu.inference.kvcache import KVCacheSpec, PagePool
+    spec = KVCacheSpec(layers=1, slots=2, capacity=32, kv_heads_local=1,
+                       head_dim=8, page_tokens=8)
+    pool = PagePool(spec)
+    lock_before = pool._lock
+    grant = pool.admit(0, [1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    assert grant is not None
+    pool.reset()
+    assert pool._lock is lock_before
+    assert len(pool._free) == spec.num_pages
+    assert pool.admit(0, [1, 2, 3, 4, 5, 6, 7, 8, 9], 4) is not None
